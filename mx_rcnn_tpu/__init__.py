@@ -1,0 +1,27 @@
+"""mx_rcnn_tpu — a TPU-native region-based object-detection framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of the classic MXNet
+Faster R-CNN framework (reference: acaridor/mx-rcnn, see SURVEY.md):
+
+- Faster R-CNN training (end-to-end and 4-stage alternate optimization) and
+  Fast R-CNN, with VGG-16 and ResNet-50/101 (C4) backbones, extended with
+  FPN / Mask R-CNN heads.
+- PASCAL VOC and COCO datasets with in-repo evaluation (VOC AP and COCO
+  mAP@[.5:.95] including an RLE mask API — pycocotools is not a dependency).
+- All detection ops (Proposal, NMS, ROIAlign/ROIPool, box/anchor math,
+  anchor/roi target assignment) are static-shape, jit-traceable JAX
+  functions / Pallas kernels that run *inside* the compiled train step —
+  no host round-trips (the reference runs these as Python CustomOps /
+  Cython / CUDA: rcnn/symbol/proposal.py, rcnn/cython/*, rcnn/processing/*).
+- Data parallelism is a `jax.sharding.Mesh` + jit-with-shardings train step
+  with XLA `psum` gradient allreduce over ICI/DCN (the reference uses MXNet
+  Module/KVStore: rcnn/core/module.py).
+
+Design rules (TPU-first):
+- Static shapes everywhere: fixed max counts + validity masks replace every
+  data-dependent filter in the reference.
+- bfloat16 matmul path, float32 parameters and losses.
+- No data-dependent Python control flow inside jit; `lax` control flow only.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,279 @@
+"""Immutable configuration tree.
+
+Replaces the reference's global mutable ``easydict`` config
+(rcnn/config.py: ``config``, ``default``, ``network``, ``dataset``,
+``generate_config(net, ds)``) with a frozen dataclass tree. Numeric defaults
+follow the reference's classic Faster R-CNN hyperparameters; every field that
+the reference exposes has an equivalent here. ``generate_config`` keeps the
+same name and role: merge per-network and per-dataset presets.
+
+TPU delta vs the reference: shapes are static. ``TrainConfig.max_gt_boxes``
+pads the gt-box tensor, ``rpn_post_nms_top_n`` / ``batch_rois`` are exact
+(masked) counts, and image batches are padded to ``image_pad_shape``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field, replace
+from typing import Any, Mapping
+
+
+@dataclass(frozen=True)
+class NetworkConfig:
+    """Per-backbone structural config (reference: rcnn/config.py `network.*`)."""
+
+    name: str = "resnet50"
+    # Anchors (reference: generate_anchors(base_size=16, ratios, scales)).
+    anchor_base_size: int = 16
+    anchor_ratios: tuple = (0.5, 1.0, 2.0)
+    anchor_scales: tuple = (8, 16, 32)
+    rpn_feat_stride: int = 16
+    # Backbone freezing (reference: fixed_param_prefix in train_end2end.py).
+    fixed_param_patterns: tuple = ("conv0", "bn0", "stage1", "gamma", "beta")
+    # Head pooling (reference: ROIPooling 7x7 VGG / 14x14 ResNet, 1/16 scale).
+    roi_pool_size: int = 14
+    roi_pool_type: str = "align"  # "align" | "max" — reference uses max-pool
+    # Channels of the stride-16 feature map (C4): 1024 for ResNet, 512 VGG.
+    feat_channels: int = 1024
+    depth: int = 50  # resnet depth; unused for vgg
+    # bfloat16 compute for conv/matmul path.
+    compute_dtype: str = "bfloat16"
+    # FPN (off for the classic C4 configs).
+    use_fpn: bool = False
+    fpn_strides: tuple = (4, 8, 16, 32, 64)
+    fpn_channels: int = 256
+    # Mask head (Mask R-CNN configs).
+    use_mask: bool = False
+    mask_pool_size: int = 14
+    mask_resolution: int = 28
+
+    @property
+    def num_anchors(self) -> int:
+        return len(self.anchor_ratios) * len(self.anchor_scales)
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training hyperparameters (reference: rcnn/config.py `config.TRAIN`)."""
+
+    # RPN anchor target assignment (reference: rcnn/io/rpn.py assign_anchor).
+    rpn_batch_size: int = 256
+    rpn_fg_fraction: float = 0.5
+    rpn_positive_overlap: float = 0.7
+    rpn_negative_overlap: float = 0.3
+    rpn_clobber_positives: bool = False
+    rpn_allowed_border: int = 0
+    # Proposal op (train mode) (reference: rcnn/symbol/proposal.py).
+    rpn_pre_nms_top_n: int = 12000
+    rpn_post_nms_top_n: int = 2000
+    rpn_nms_thresh: float = 0.7
+    rpn_min_size: int = 16
+    # RCNN roi sampling (reference: rcnn/io/rcnn.py sample_rois).
+    batch_rois: int = 128
+    fg_fraction: float = 0.25
+    fg_thresh: float = 0.5
+    bg_thresh_hi: float = 0.5
+    bg_thresh_lo: float = 0.0
+    # NOTE: the reference uses bg_thresh_lo=0.1 for the Fast-RCNN path and 0.0
+    # for end2end; end2end default kept here.
+    # bbox regression target normalization (reference: config.TRAIN.BBOX_*).
+    bbox_normalization_precomputed: bool = True
+    bbox_means: tuple = (0.0, 0.0, 0.0, 0.0)
+    bbox_stds: tuple = (0.1, 0.1, 0.2, 0.2)
+    # Optimizer (reference: train_end2end.py fit kwargs).
+    lr: float = 0.001
+    lr_step: tuple = (7,)  # epochs at which lr is divided by lr_factor
+    lr_factor: float = 0.1
+    momentum: float = 0.9
+    wd: float = 0.0005
+    clip_gradient: float = 5.0
+    begin_epoch: int = 0
+    end_epoch: int = 10
+    # Data
+    batch_images: int = 1  # images per device
+    shuffle: bool = True
+    flip: bool = True
+    aspect_grouping: bool = True
+    # Static-shape padding (TPU design decision — no reference equivalent).
+    max_gt_boxes: int = 100
+    # Loss scaling constants (reference scales smooth-L1 by 1/RPN_BATCH and
+    # 1/BATCH_ROIS via grad_scale, NOT by live fg counts).
+    # end2end switch retained for the alternate-training tools.
+    end2end: bool = True
+
+
+@dataclass(frozen=True)
+class TestConfig:
+    """Inference hyperparameters (reference: rcnn/config.py `config.TEST`)."""
+
+    rpn_pre_nms_top_n: int = 6000
+    rpn_post_nms_top_n: int = 300
+    rpn_nms_thresh: float = 0.7
+    rpn_min_size: int = 16
+    # Final detection post-processing (reference: rcnn/core/tester.py pred_eval).
+    nms_thresh: float = 0.3
+    score_thresh: float = 0.05
+    max_per_image: int = 100
+    # Proposal-generation mode (alternate training / Fast R-CNN).
+    proposal_nms_thresh: float = 0.7
+
+
+@dataclass(frozen=True)
+class DatasetConfig:
+    """Per-dataset config (reference: rcnn/config.py `dataset.*`)."""
+
+    name: str = "coco"
+    root_path: str = "data"
+    dataset_path: str = "data/coco"
+    image_set: str = "train2017"
+    test_image_set: str = "val2017"
+    num_classes: int = 81  # incl. background
+    class_names: tuple = ()
+
+
+@dataclass(frozen=True)
+class ImageConfig:
+    """Image pipeline (reference: config.SCALES / PIXEL_MEANS, rcnn/io/image.py)."""
+
+    scales: tuple = ((600, 1000),)  # (target short side, max long side)
+    pixel_means: tuple = (123.68, 116.779, 103.939)  # RGB (reference stores BGR)
+    pixel_stds: tuple = (1.0, 1.0, 1.0)
+    # Static padded shape (H, W) every image batch is padded to. Must be a
+    # multiple of the max feature stride. 1024 covers the (600,1000) scale.
+    pad_shape: tuple = (1024, 1024)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout (replaces the reference's --gpus/--kvstore flags).
+
+    The reference's only parallelism is data parallel (rcnn/core/module.py
+    MutableModule over a context list + KVStore allreduce). Here a
+    `jax.sharding.Mesh` with axes (data, model) covers DP and leaves room for
+    model/spatial sharding; `mesh_shape="8"` or `"4x2"` style strings come
+    from the `--tpu-mesh` CLI flag.
+    """
+
+    mesh_shape: str = "1"
+    data_axis: str = "data"
+    model_axis: str = "model"
+
+
+@dataclass(frozen=True)
+class Config:
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    test: TestConfig = field(default_factory=TestConfig)
+    dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    image: ImageConfig = field(default_factory=ImageConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    seed: int = 0
+
+    def with_updates(self, **kw) -> "Config":
+        return replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Presets (reference: rcnn/config.py per-network / per-dataset dicts merged by
+# generate_config)
+# ---------------------------------------------------------------------------
+
+_NETWORK_PRESETS: Mapping[str, Mapping[str, Any]] = {
+    "vgg": dict(
+        name="vgg",
+        feat_channels=512,
+        roi_pool_size=7,
+        depth=16,
+        fixed_param_patterns=("conv1_1", "conv1_2", "conv2_1", "conv2_2"),
+    ),
+    "resnet50": dict(name="resnet50", depth=50),
+    "resnet101": dict(name="resnet101", depth=101),
+    "resnet50_fpn": dict(
+        name="resnet50_fpn", depth=50, use_fpn=True, roi_pool_size=7,
+        anchor_scales=(8,),
+    ),
+    "resnet101_fpn": dict(
+        name="resnet101_fpn", depth=101, use_fpn=True, roi_pool_size=7,
+        anchor_scales=(8,),
+    ),
+    "resnet50_fpn_mask": dict(
+        name="resnet50_fpn_mask", depth=50, use_fpn=True, roi_pool_size=7,
+        anchor_scales=(8,), use_mask=True,
+    ),
+    "resnet101_fpn_mask": dict(
+        name="resnet101_fpn_mask", depth=101, use_fpn=True, roi_pool_size=7,
+        anchor_scales=(8,), use_mask=True,
+    ),
+}
+
+VOC_CLASSES = (
+    "__background__",
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+)
+
+_DATASET_PRESETS: Mapping[str, Mapping[str, Any]] = {
+    "PascalVOC": dict(
+        name="PascalVOC",
+        dataset_path="data/VOCdevkit",
+        image_set="2007_trainval",
+        test_image_set="2007_test",
+        num_classes=21,
+        class_names=VOC_CLASSES,
+    ),
+    "coco": dict(
+        name="coco",
+        dataset_path="data/coco",
+        image_set="train2017",
+        test_image_set="val2017",
+        num_classes=81,
+    ),
+    "synthetic": dict(
+        name="synthetic",
+        dataset_path="",
+        image_set="train",
+        test_image_set="test",
+        num_classes=4,
+    ),
+}
+
+
+def generate_config(network: str, dataset: str, **overrides) -> Config:
+    """Build a Config from a network preset + dataset preset.
+
+    Mirrors the reference's ``generate_config(network, dataset)``
+    (rcnn/config.py) which merges ``network.<net>`` and ``dataset.<ds>``
+    dicts into the globals; here it returns a fresh immutable Config.
+    """
+    if network not in _NETWORK_PRESETS:
+        raise KeyError(f"unknown network {network!r}; have {sorted(_NETWORK_PRESETS)}")
+    if dataset not in _DATASET_PRESETS:
+        raise KeyError(f"unknown dataset {dataset!r}; have {sorted(_DATASET_PRESETS)}")
+    cfg = Config(
+        network=NetworkConfig(**_NETWORK_PRESETS[network]),
+        dataset=DatasetConfig(**_DATASET_PRESETS[dataset]),
+    )
+    if overrides:
+        cfg = _apply_dotted_overrides(cfg, overrides)
+    return cfg
+
+
+def _apply_dotted_overrides(cfg: Config, overrides: Mapping[str, Any]) -> Config:
+    """Apply {"train.lr": 0.002, "test.nms_thresh": 0.5}-style overrides."""
+    grouped: dict = {}
+    for key, value in overrides.items():
+        if "." in key:
+            section, leaf = key.split(".", 1)
+            grouped.setdefault(section, {})[leaf] = value
+        else:
+            grouped[key] = value
+    updates = {}
+    for section, value in grouped.items():
+        current = getattr(cfg, section)
+        if isinstance(value, Mapping) and dataclasses.is_dataclass(current):
+            updates[section] = replace(current, **value)
+        else:
+            updates[section] = value
+    return replace(cfg, **updates)

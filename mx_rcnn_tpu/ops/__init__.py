@@ -1,0 +1,18 @@
+"""Pure, static-shape, jit-traceable detection ops.
+
+TPU-native replacements for the reference's host-side box math
+(rcnn/processing/bbox_transform.py, generate_anchor.py, nms.py), Cython/CUDA
+kernels (rcnn/cython/bbox.pyx, cpu_nms.pyx, nms_kernel.cu) and in-graph
+custom ops (rcnn/symbol/proposal.py, MXNet's C++ ROIPooling/ROIAlign).
+"""
+
+from mx_rcnn_tpu.ops.boxes import (
+    bbox_transform,
+    bbox_pred,
+    clip_boxes,
+    bbox_overlaps,
+)
+from mx_rcnn_tpu.ops.anchors import generate_anchors, anchor_grid
+from mx_rcnn_tpu.ops.nms import nms
+from mx_rcnn_tpu.ops.roi_align import roi_align, roi_pool
+from mx_rcnn_tpu.ops.proposal import generate_proposals

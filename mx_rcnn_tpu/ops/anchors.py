@@ -1,0 +1,95 @@
+"""Anchor generation.
+
+Replaces rcnn/processing/generate_anchor.py (the classic Girshick
+``generate_anchors``) plus the feature-map shift enumeration that the
+reference repeats inside assign_anchor (rcnn/io/rpn.py) and the Proposal op
+(rcnn/symbol/proposal.py). Anchors are compile-time constants under jit:
+``anchor_grid`` is pure numpy on static shapes, so XLA folds it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _whctrs(anchor: np.ndarray):
+    w = anchor[2] - anchor[0] + 1.0
+    h = anchor[3] - anchor[1] + 1.0
+    cx = anchor[0] + 0.5 * (w - 1.0)
+    cy = anchor[1] + 0.5 * (h - 1.0)
+    return w, h, cx, cy
+
+
+def _mkanchors(ws, hs, cx, cy):
+    ws = ws[:, None]
+    hs = hs[:, None]
+    return np.hstack(
+        [
+            cx - 0.5 * (ws - 1.0),
+            cy - 0.5 * (hs - 1.0),
+            cx + 0.5 * (ws - 1.0),
+            cy + 0.5 * (hs - 1.0),
+        ]
+    )
+
+
+def _ratio_enum(anchor, ratios):
+    w, h, cx, cy = _whctrs(anchor)
+    size = w * h
+    size_ratios = size / ratios
+    ws = np.round(np.sqrt(size_ratios))
+    hs = np.round(ws * ratios)
+    return _mkanchors(ws, hs, cx, cy)
+
+
+def _scale_enum(anchor, scales):
+    w, h, cx, cy = _whctrs(anchor)
+    ws = w * scales
+    hs = h * scales
+    return _mkanchors(ws, hs, cx, cy)
+
+
+def generate_anchors(
+    base_size: int = 16,
+    ratios=(0.5, 1.0, 2.0),
+    scales=(8, 16, 32),
+) -> np.ndarray:
+    """(A, 4) base anchors centred on the (0,0) stride cell.
+
+    Bit-exact port of the classic algorithm's semantics (ratio enumeration
+    with rounding, then scale enumeration) — the rounding matters for parity
+    with reference-trained checkpoints.
+    """
+    ratios = np.asarray(ratios, dtype=np.float64)
+    scales = np.asarray(scales, dtype=np.float64)
+    base_anchor = np.array([0, 0, base_size - 1, base_size - 1], dtype=np.float64)
+    ratio_anchors = _ratio_enum(base_anchor, ratios)
+    anchors = np.vstack(
+        [_scale_enum(ratio_anchors[i], scales) for i in range(ratio_anchors.shape[0])]
+    )
+    return anchors.astype(np.float32)
+
+
+def anchor_grid(
+    feat_height: int,
+    feat_width: int,
+    stride: int = 16,
+    base_size: int = 16,
+    ratios=(0.5, 1.0, 2.0),
+    scales=(8, 16, 32),
+) -> np.ndarray:
+    """All anchors for an HxW feature map, shape (H*W*A, 4).
+
+    Enumeration order matches the reference (rcnn/io/rpn.py assign_anchor /
+    rcnn/symbol/proposal.py): shifts vary fastest over W, then H; the A base
+    anchors are the innermost group, i.e. reshape of
+    (1,H,W,A,4) -> (H*W*A, 4). This ordering must match the (A·4, H, W)
+    layout of the RPN conv outputs after transpose/reshape.
+    """
+    base = generate_anchors(base_size, ratios, scales)  # (A,4)
+    shift_x = np.arange(feat_width, dtype=np.float32) * stride
+    shift_y = np.arange(feat_height, dtype=np.float32) * stride
+    sx, sy = np.meshgrid(shift_x, shift_y)  # (H,W)
+    shifts = np.stack([sx, sy, sx, sy], axis=-1)  # (H,W,4)
+    all_anchors = shifts[:, :, None, :] + base[None, None, :, :]  # (H,W,A,4)
+    return all_anchors.reshape(-1, 4)

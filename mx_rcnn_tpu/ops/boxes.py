@@ -1,0 +1,118 @@
+"""Box geometry: transforms, decoding, clipping, IoU.
+
+Replaces the reference's rcnn/processing/bbox_transform.py (bbox_transform,
+bbox_pred, clip_boxes, numpy bbox_overlaps) and rcnn/cython/bbox.pyx
+(bbox_overlaps_cython). Everything is pure jnp, differentiable where it makes
+sense, and shape-polymorphic in the leading box count (which is always static
+under jit).
+
+Numeric contract (silent-mAP-killer territory, see SURVEY.md §8): the
+reference uses *inclusive* pixel coordinates, so a box (x1,y1,x2,y2) has
+width x2-x1+1. That +1.0 is preserved everywhere here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Matches the reference's clamp on dw/dh before exp (py-faster-rcnn lineage
+# clamps at log(1000/16); the classic mx-rcnn relies on training stability —
+# we clamp for TPU-safety, it is a no-op for in-range deltas).
+_BBOX_XFORM_CLIP = jnp.log(1000.0 / 16.0)
+
+
+def _whctrs(boxes: jnp.ndarray):
+    """(x1,y1,x2,y2) -> (w, h, cx, cy) with the +1 inclusive convention."""
+    w = boxes[..., 2] - boxes[..., 0] + 1.0
+    h = boxes[..., 3] - boxes[..., 1] + 1.0
+    cx = boxes[..., 0] + 0.5 * (w - 1.0)
+    cy = boxes[..., 1] + 0.5 * (h - 1.0)
+    return w, h, cx, cy
+
+
+def bbox_transform(ex_rois: jnp.ndarray, gt_rois: jnp.ndarray) -> jnp.ndarray:
+    """Regression targets (dx,dy,dw,dh) taking ex_rois onto gt_rois.
+
+    Reference: rcnn/processing/bbox_transform.py::bbox_transform.
+    ex_rois, gt_rois: (..., 4). Returns (..., 4).
+    """
+    ew, eh, ecx, ecy = _whctrs(ex_rois)
+    gw, gh, gcx, gcy = _whctrs(gt_rois)
+    # 1e-14 guards the padded/degenerate rows; real boxes have w,h >= 1.
+    dx = (gcx - ecx) / (ew + 1e-14)
+    dy = (gcy - ecy) / (eh + 1e-14)
+    dw = jnp.log(gw / (ew + 1e-14) + 1e-14)
+    dh = jnp.log(gh / (eh + 1e-14) + 1e-14)
+    return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+
+def bbox_pred(boxes: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
+    """Decode deltas on top of boxes (inverse of bbox_transform).
+
+    Reference: rcnn/processing/bbox_transform.py::bbox_pred.
+    boxes: (..., N, 4); deltas: (..., N, 4*K) for K classes (K=1 for RPN).
+    Returns (..., N, 4*K).
+    """
+    w, h, cx, cy = _whctrs(boxes)
+    # Broadcast the box geometry over the K per-class delta groups.
+    shape = deltas.shape[:-1] + (deltas.shape[-1] // 4, 4)
+    d = deltas.reshape(shape)
+    dx, dy = d[..., 0], d[..., 1]
+    dw = jnp.clip(d[..., 2], max=_BBOX_XFORM_CLIP)
+    dh = jnp.clip(d[..., 3], max=_BBOX_XFORM_CLIP)
+    w_ = w[..., None]
+    h_ = h[..., None]
+    pcx = dx * w_ + cx[..., None]
+    pcy = dy * h_ + cy[..., None]
+    pw = jnp.exp(dw) * w_
+    ph = jnp.exp(dh) * h_
+    out = jnp.stack(
+        [
+            pcx - 0.5 * (pw - 1.0),
+            pcy - 0.5 * (ph - 1.0),
+            pcx + 0.5 * (pw - 1.0),
+            pcy + 0.5 * (ph - 1.0),
+        ],
+        axis=-1,
+    )
+    return out.reshape(deltas.shape)
+
+
+def clip_boxes(boxes: jnp.ndarray, im_shape) -> jnp.ndarray:
+    """Clip (..., 4*K) boxes to [0, W-1] x [0, H-1].
+
+    Reference: rcnn/processing/bbox_transform.py::clip_boxes.
+    im_shape: (H, W) scalars or arrays broadcastable over the leading dims.
+    """
+    h, w = im_shape[0], im_shape[1]
+    shape = boxes.shape[:-1] + (boxes.shape[-1] // 4, 4)
+    b = boxes.reshape(shape)
+    x1 = jnp.clip(b[..., 0], 0.0, w - 1.0)
+    y1 = jnp.clip(b[..., 1], 0.0, h - 1.0)
+    x2 = jnp.clip(b[..., 2], 0.0, w - 1.0)
+    y2 = jnp.clip(b[..., 3], 0.0, h - 1.0)
+    return jnp.stack([x1, y1, x2, y2], axis=-1).reshape(boxes.shape)
+
+
+def bbox_overlaps(boxes: jnp.ndarray, query_boxes: jnp.ndarray) -> jnp.ndarray:
+    """Dense IoU matrix, (N,4) x (K,4) -> (N,K).
+
+    Replaces rcnn/cython/bbox.pyx::bbox_overlaps_cython — on TPU the O(N·K)
+    matrix is a vectorized broadcast, no kernel needed. Inclusive (+1) widths
+    as in the reference. Degenerate/padded query rows (area <= 0 after the +1
+    convention requires x2>=x1) yield overlap 0 via the max(0, ...) clamps and
+    a non-negative union, so callers can pad with zero boxes safely *if* they
+    also mask; a (0,0,0,0) pad box has area 1 and can produce tiny IoUs —
+    always mask padded rows downstream.
+    """
+    b = boxes[:, None, :]
+    q = query_boxes[None, :, :]
+    iw = jnp.minimum(b[..., 2], q[..., 2]) - jnp.maximum(b[..., 0], q[..., 0]) + 1.0
+    ih = jnp.minimum(b[..., 3], q[..., 3]) - jnp.maximum(b[..., 1], q[..., 1]) + 1.0
+    iw = jnp.maximum(iw, 0.0)
+    ih = jnp.maximum(ih, 0.0)
+    inter = iw * ih
+    area_b = (b[..., 2] - b[..., 0] + 1.0) * (b[..., 3] - b[..., 1] + 1.0)
+    area_q = (q[..., 2] - q[..., 0] + 1.0) * (q[..., 3] - q[..., 1] + 1.0)
+    union = area_b + area_q - inter
+    return inter / jnp.maximum(union, 1e-14)

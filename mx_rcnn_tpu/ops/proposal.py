@@ -1,0 +1,112 @@
+"""Proposal generation — in-graph, static-shape.
+
+Replaces the reference's Proposal custom op (rcnn/symbol/proposal.py
+ProposalOperator, and the C++/CUDA ``mx.contrib.sym.Proposal`` selected by
+config.CXX_PROPOSAL). In the reference this op is a host round-trip when the
+Python version is used (GPU→CPU→GPU per step); here it is a pure traced
+function inside the jitted train step.
+
+Pipeline (reference semantics, static shapes):
+  anchors (compile-time const) + rpn deltas → bbox_pred → clip to image
+  → min-size filter (mask, not drop) → top pre_nms_top_n by score
+  → greedy NMS(thresh) → top post_nms_top_n, padded + validity mask.
+
+The reference pads a short post-NMS set by *re-sampling kept rois*
+(proposal.py pads with random duplicates) so downstream shapes hold; we pad
+with the first kept roi and carry an explicit validity mask — downstream
+sampling (ProposalTarget analog) respects the mask, which the reference's
+duplicate-padding only approximates.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
+from mx_rcnn_tpu.ops.nms import nms, nms_bitmask
+
+# Above this many candidate boxes the O(N²) bitmask IoU matrix (~N²·4 bytes
+# plus same-shape temporaries) stops fitting comfortably next to backbone
+# activations in HBM; fall back to the O(max_output·N) iterative kernel.
+_BITMASK_NMS_MAX_BOXES = 6144
+
+
+def generate_proposals(
+    rpn_cls_prob: jnp.ndarray,
+    rpn_bbox_pred: jnp.ndarray,
+    im_info: jnp.ndarray,
+    anchors: jnp.ndarray,
+    *,
+    pre_nms_top_n: int,
+    post_nms_top_n: int,
+    nms_thresh: float,
+    min_size: float,
+    feat_stride: int = 16,
+):
+    """Batched proposal generation.
+
+    Args:
+      rpn_cls_prob: (B, H, W, 2A) — softmaxed scores, channel layout
+        [bg*A, fg*A] along the last dim (matching the reference's
+        (2A, H, W) NCHW layout transposed to NHWC).
+      rpn_bbox_pred: (B, H, W, 4A) deltas.
+      im_info: (B, 3) rows (im_height, im_width, im_scale) — the true
+        (unpadded) image extent, as in the reference.
+      anchors: (H*W*A, 4) from ops.anchors.anchor_grid (static const).
+      min_size: min box side at the ORIGINAL scale; scaled by im_scale as in
+        the reference (proposal.py: min_size * im_info[2]).
+
+    Returns:
+      rois: (B, post_nms_top_n, 4) image-coordinate boxes,
+      roi_valid: (B, post_nms_top_n) bool.
+    """
+    b, h, w, twice_a = rpn_cls_prob.shape
+    a = twice_a // 2
+    # fg scores: channels [A:2A). Reshape to (B, H*W*A) matching anchor order
+    # (H, then W, then A fastest).
+    fg = rpn_cls_prob[..., a:]  # (B, H, W, A)
+    scores = fg.reshape(b, -1).astype(jnp.float32)
+    deltas = rpn_bbox_pred.reshape(b, -1, 4).astype(jnp.float32)
+
+    return jax.vmap(
+        partial(
+            _proposals_one_image,
+            pre_nms_top_n=pre_nms_top_n,
+            post_nms_top_n=post_nms_top_n,
+            nms_thresh=nms_thresh,
+            min_size=min_size,
+        ),
+        in_axes=(0, 0, 0, None),
+    )(scores, deltas, im_info, anchors)
+
+
+def _proposals_one_image(
+    scores, deltas, im_info, anchors, *, pre_nms_top_n, post_nms_top_n, nms_thresh, min_size
+):
+    n = anchors.shape[0]
+    boxes = bbox_pred(anchors, deltas)  # (N, 4)
+    boxes = clip_boxes(boxes, (im_info[0], im_info[1]))
+    # min-size filter (reference: _filter_boxes with min_size * im_scale).
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    min_sz = min_size * im_info[2]
+    size_ok = (ws >= min_sz) & (hs >= min_sz)
+    scores = jnp.where(size_ok, scores, -1e10)
+    # top-k pre-NMS trim.
+    k = min(pre_nms_top_n, n)
+    top_scores, top_idx = lax.top_k(scores, k)
+    top_boxes = boxes[top_idx]
+    top_valid = top_scores > -1e9
+    nms_fn = nms_bitmask if k <= _BITMASK_NMS_MAX_BOXES else nms
+    keep_idx, keep_valid = nms_fn(
+        top_boxes, top_scores, top_valid, nms_thresh, post_nms_top_n
+    )
+    rois = top_boxes[keep_idx]
+    # Pad invalid slots with the first (highest-score) kept roi so downstream
+    # pooling reads a real box; validity mask excludes them from sampling.
+    rois = jnp.where(keep_valid[:, None], rois, rois[0][None, :])
+    return rois, keep_valid

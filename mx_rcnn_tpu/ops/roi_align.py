@@ -1,0 +1,155 @@
+"""ROIAlign / ROIPooling as traceable JAX ops.
+
+Replaces MXNet's C++/CUDA builtins ``mx.symbol.ROIPooling`` and
+``mx.contrib.sym.ROIAlign`` that the reference wires into its graphs
+(rcnn/symbol/symbol_vgg.py 7x7 pool, rcnn/symbol/symbol_resnet.py 14x14 pool,
+spatial_scale 1/16).
+
+Formulation: both ops are expressed as dense gather + weighted reduction over
+a static sampling grid, vmapped over ROIs — XLA lowers the gathers well and
+there are no dynamic shapes. A Pallas fused-gather kernel is the planned fast
+path; this is the semantic reference for it.
+
+- ``roi_align``: bilinear sampling, ``sampling_ratio`` points per bin axis,
+  average-pooled (He et al. Mask R-CNN semantics; ``aligned=True`` applies the
+  -0.5 half-pixel correction of Detectron2, default False matches the classic
+  MXNet contrib op).
+- ``roi_pool``: quantized max pooling (classic Fast R-CNN semantics used by
+  the reference's training graphs).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _bilinear_gather(feat: jnp.ndarray, y: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Sample feat (H, W, C) at continuous points y, x (...,) -> (..., C).
+
+    Out-of-bounds points clamp to the border (matching the CUDA kernels'
+    behavior of clipping sample coords to the feature extent).
+    """
+    h, w = feat.shape[0], feat.shape[1]
+    y = jnp.clip(y, 0.0, h - 1.0)
+    x = jnp.clip(x, 0.0, w - 1.0)
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1 = jnp.minimum(y0 + 1.0, h - 1.0)
+    x1 = jnp.minimum(x0 + 1.0, w - 1.0)
+    ly = y - y0
+    lx = x - x0
+    hy = 1.0 - ly
+    hx = 1.0 - lx
+    y0i, x0i, y1i, x1i = (a.astype(jnp.int32) for a in (y0, x0, y1, x1))
+    v00 = feat[y0i, x0i]
+    v01 = feat[y0i, x1i]
+    v10 = feat[y1i, x0i]
+    v11 = feat[y1i, x1i]
+    wdt = feat.dtype
+    return (
+        v00 * (hy * hx)[..., None].astype(wdt)
+        + v01 * (hy * lx)[..., None].astype(wdt)
+        + v10 * (ly * hx)[..., None].astype(wdt)
+        + v11 * (ly * lx)[..., None].astype(wdt)
+    )
+
+
+def roi_align(
+    features: jnp.ndarray,
+    rois: jnp.ndarray,
+    output_size: int,
+    spatial_scale: float,
+    sampling_ratio: int = 2,
+    aligned: bool = False,
+) -> jnp.ndarray:
+    """ROIAlign.
+
+    Args:
+      features: (B, H, W, C) feature maps (NHWC — TPU-native layout; the
+        reference's graphs are NCHW because cuDNN prefers it).
+      rois: (R, 5) rows of (batch_idx, x1, y1, x2, y2) in image coords —
+        same layout as the reference's Proposal op output.
+      output_size: pooled grid side P.
+      spatial_scale: e.g. 1/16 for C4.
+      sampling_ratio: sample points per bin axis.
+      aligned: half-pixel correction.
+
+    Returns: (R, P, P, C).
+    """
+    p = output_size
+    s = sampling_ratio
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0) if not aligned else (x2 - x1)
+        rh = jnp.maximum(y2 - y1, 1.0) if not aligned else (y2 - y1)
+        bin_w = rw / p
+        bin_h = rh / p
+        # Sample grid: for bin (i,j), points at
+        # y1 + (i + (k+0.5)/s) * bin_h, k in [0,s)
+        grid = (jnp.arange(p * s, dtype=features.dtype) + 0.5) / s
+        ys = y1 + grid * bin_h  # (p*s,)
+        xs = x1 + grid * bin_w
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")  # (p*s, p*s)
+        vals = _bilinear_gather(features[b], yy, xx)  # (p*s, p*s, C)
+        # Average the s*s samples per bin.
+        c = vals.shape[-1]
+        vals = vals.reshape(p, s, p, s, c)
+        return vals.mean(axis=(1, 3))
+
+    return jax.vmap(one_roi)(rois)
+
+
+def roi_pool(
+    features: jnp.ndarray,
+    rois: jnp.ndarray,
+    output_size: int,
+    spatial_scale: float,
+) -> jnp.ndarray:
+    """Classic quantized max ROIPooling (mx.symbol.ROIPooling semantics).
+
+    Bin boundaries are computed by integer quantization (round of scaled
+    coords, floor/ceil of bin edges); empty bins yield 0 (the CUDA kernel
+    emits 0 for empty bins). Implemented densely: for each bin, a max over a
+    masked window of the (static) feature map — O(P²·H·W) per ROI is fine at
+    C4 sizes (64×64 feature map) and keeps shapes static.
+    """
+    p = output_size
+    h, w = features.shape[1], features.shape[2]
+    fy = jnp.arange(h, dtype=jnp.float32)
+    fx = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        # Reference quantizes roi coords with round().
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_w = rw / p
+        bin_h = rh / p
+        i = jnp.arange(p, dtype=jnp.float32)
+        ys_lo = jnp.floor(y1 + i * bin_h)  # (p,)
+        ys_hi = jnp.ceil(y1 + (i + 1.0) * bin_h)
+        xs_lo = jnp.floor(x1 + i * bin_w)
+        xs_hi = jnp.ceil(x1 + (i + 1.0) * bin_w)
+        # Mask (p, H): feature row r in bin i iff ys_lo[i] <= r < ys_hi[i].
+        row_in = (fy[None, :] >= ys_lo[:, None]) & (fy[None, :] < ys_hi[:, None])
+        col_in = (fx[None, :] >= xs_lo[:, None]) & (fx[None, :] < xs_hi[:, None])
+        feat = features[b]  # (H, W, C)
+        neg = jnp.asarray(-jnp.inf, feat.dtype)
+        # (p, 1, H, 1, 1) & (1, p, 1, W, 1) -> mask (p,p,H,W,1)
+        mask = row_in[:, None, :, None, None] & col_in[None, :, None, :, None]
+        masked = jnp.where(mask, feat[None, None], neg)
+        out = masked.max(axis=(2, 3))  # (p, p, C)
+        return jnp.where(jnp.isfinite(out), out, 0.0).astype(feat.dtype)
+
+    return jax.vmap(one_roi)(rois)

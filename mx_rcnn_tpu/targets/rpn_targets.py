@@ -1,0 +1,125 @@
+"""RPN anchor target assignment — `assign_anchor`, traceable.
+
+Reference: rcnn/io/rpn.py::assign_anchor, which runs on the host inside
+AnchorLoader with Cython IoU. Here it is a pure static-shape JAX function that
+runs inside the jitted train step, vmapped over the batch.
+
+Reference semantics reproduced:
+- only anchors fully inside the (true, unpadded) image ± allowed_border
+  participate; the rest stay at label −1 (ignore);
+- label 0 where max IoU < negative_overlap;
+- label 1 for the best anchor(s) per gt box (ties included) and wherever
+  max IoU ≥ positive_overlap (in that order — positives clobber negatives
+  unless rpn_clobber_positives);
+- subsample to `rpn_batch_size` anchors with at most
+  `rpn_fg_fraction·batch` positives, disabling the excess *at random*
+  (label → −1);
+- bbox targets = bbox_transform(anchor, matched gt), weight 1 on positives.
+
+Static-shape deltas vs the reference: nothing is dropped — all H·W·A anchors
+flow through with labels; gt boxes arrive padded to a fixed count with a
+validity mask.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.boxes import bbox_overlaps, bbox_transform
+
+
+class RpnTargets(NamedTuple):
+    labels: jnp.ndarray        # (N,) int32 in {-1, 0, 1}
+    bbox_targets: jnp.ndarray  # (N, 4) float32
+    bbox_weights: jnp.ndarray  # (N, 4) float32 (1 on positives)
+
+
+def _random_subsample(mask: jnp.ndarray, limit, key) -> jnp.ndarray:
+    """Keep at most `limit` True entries of mask, chosen uniformly.
+
+    Matches the reference's `npr.choice(fg_inds, size=excess, replace=False)`
+    disabling. `limit` may be a traced scalar.
+    """
+    n = mask.shape[0]
+    keys = jnp.where(mask, jax.random.uniform(key, (n,)), 2.0)
+    order = jnp.argsort(keys)
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    return mask & (rank < limit)
+
+
+def assign_anchor(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    im_info: jnp.ndarray,
+    key: jax.Array,
+    *,
+    rpn_batch_size: int = 256,
+    rpn_fg_fraction: float = 0.5,
+    positive_overlap: float = 0.7,
+    negative_overlap: float = 0.3,
+    allowed_border: float = 0.0,
+    clobber_positives: bool = False,
+) -> RpnTargets:
+    """Single-image anchor assignment. vmap over batch at the call site.
+
+    Args:
+      anchors: (N, 4) static anchor grid (ops.anchors.anchor_grid).
+      gt_boxes: (G, 4) padded gt boxes (x1,y1,x2,y2).
+      gt_valid: (G,) bool.
+      im_info: (3,) = (height, width, scale) of the true image extent.
+      key: PRNG key for the subsampling.
+    """
+    n = anchors.shape[0]
+    k_fg, k_bg = jax.random.split(key)
+
+    inside = (
+        (anchors[:, 0] >= -allowed_border)
+        & (anchors[:, 1] >= -allowed_border)
+        & (anchors[:, 2] < im_info[1] + allowed_border)
+        & (anchors[:, 3] < im_info[0] + allowed_border)
+    )
+
+    iou = bbox_overlaps(anchors, gt_boxes)  # (N, G)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    any_gt = jnp.any(gt_valid)
+    max_iou = jnp.max(iou, axis=1)
+    argmax_gt = jnp.argmax(iou, axis=1)
+
+    # Best anchor(s) per gt, with ties — reference recomputes equality against
+    # the per-gt max over the full overlap matrix.
+    gt_best = jnp.max(jnp.where(inside[:, None], iou, -1.0), axis=0)  # (G,)
+    is_gt_best = jnp.any(
+        (jnp.abs(iou - gt_best[None, :]) < 1e-9) & gt_valid[None, :] & (gt_best[None, :] > 0),
+        axis=1,
+    )
+
+    labels = jnp.full((n,), -1, jnp.int32)
+    neg = max_iou < negative_overlap
+    pos = (max_iou >= positive_overlap) | is_gt_best
+    if clobber_positives:
+        labels = jnp.where(inside & pos, 1, labels)
+        labels = jnp.where(inside & neg, 0, labels)
+    else:
+        labels = jnp.where(inside & neg, 0, labels)
+        labels = jnp.where(inside & pos, 1, labels)
+    # No gt boxes at all: everything inside is background (reference branch
+    # for empty gt in assign_anchor).
+    labels = jnp.where(any_gt, labels, jnp.where(inside, 0, -1))
+
+    # Subsample: cap positives, then fill the rest of the batch with negatives.
+    num_fg_cap = int(rpn_batch_size * rpn_fg_fraction)
+    fg_mask = _random_subsample(labels == 1, num_fg_cap, k_fg)
+    labels = jnp.where((labels == 1) & ~fg_mask, -1, labels)
+    n_fg = jnp.sum(fg_mask.astype(jnp.int32))
+    bg_mask = _random_subsample(labels == 0, rpn_batch_size - n_fg, k_bg)
+    labels = jnp.where((labels == 0) & ~bg_mask, -1, labels)
+
+    matched_gt = gt_boxes[argmax_gt]
+    bbox_targets = bbox_transform(anchors, matched_gt)
+    bbox_targets = jnp.where((labels == 1)[:, None], bbox_targets, 0.0)
+    bbox_weights = jnp.where((labels == 1)[:, None], 1.0, 0.0)
+    return RpnTargets(labels, bbox_targets.astype(jnp.float32), bbox_weights.astype(jnp.float32))

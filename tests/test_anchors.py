@@ -1,0 +1,45 @@
+"""Anchor generation vs the classic published values."""
+
+import numpy as np
+
+from mx_rcnn_tpu.ops.anchors import generate_anchors, anchor_grid
+
+# The canonical output of generate_anchors(16, (0.5,1,2), (8,16,32)) —
+# published in the original py-faster-rcnn docstring and reproduced by the
+# reference's rcnn/processing/generate_anchor.py.
+CANONICAL = np.array(
+    [
+        [-84.0, -40.0, 99.0, 55.0],
+        [-176.0, -88.0, 191.0, 103.0],
+        [-360.0, -184.0, 375.0, 199.0],
+        [-56.0, -56.0, 71.0, 71.0],
+        [-120.0, -120.0, 135.0, 135.0],
+        [-248.0, -248.0, 263.0, 263.0],
+        [-36.0, -80.0, 51.0, 95.0],
+        [-80.0, -168.0, 95.0, 183.0],
+        [-168.0, -344.0, 183.0, 359.0],
+    ]
+)
+
+
+def test_generate_anchors_canonical():
+    a = generate_anchors(16, (0.5, 1.0, 2.0), (8, 16, 32))
+    assert a.shape == (9, 4)
+    assert np.allclose(a, CANONICAL)
+
+
+def test_grid_shape_and_order():
+    g = anchor_grid(2, 3, stride=16)
+    assert g.shape == (2 * 3 * 9, 4)
+    base = generate_anchors()
+    # First A anchors = base anchors at shift (0,0).
+    assert np.allclose(g[:9], base)
+    # Anchor block at (h=0, w=1) is base + (16, 0).
+    assert np.allclose(g[9:18], base + np.array([16, 0, 16, 0], np.float32))
+    # Anchor block at (h=1, w=0) is base + (0, 16).
+    assert np.allclose(g[27:36], base + np.array([0, 16, 0, 16], np.float32))
+
+
+def test_single_scale_fpn_anchor_count():
+    g = anchor_grid(4, 4, stride=4, ratios=(0.5, 1.0, 2.0), scales=(8,))
+    assert g.shape == (4 * 4 * 3, 4)

@@ -1,0 +1,100 @@
+"""Unit tests for box geometry ops vs hand-computed / numpy references."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mx_rcnn_tpu.ops.boxes import bbox_transform, bbox_pred, clip_boxes, bbox_overlaps
+
+
+def np_bbox_overlaps(boxes, query):
+    """Straight numpy port of the reference O(N,K) IoU (inclusive widths)."""
+    n, k = boxes.shape[0], query.shape[0]
+    out = np.zeros((n, k), dtype=np.float64)
+    for i in range(n):
+        for j in range(k):
+            iw = min(boxes[i, 2], query[j, 2]) - max(boxes[i, 0], query[j, 0]) + 1
+            ih = min(boxes[i, 3], query[j, 3]) - max(boxes[i, 1], query[j, 1]) + 1
+            if iw > 0 and ih > 0:
+                ua = (
+                    (boxes[i, 2] - boxes[i, 0] + 1) * (boxes[i, 3] - boxes[i, 1] + 1)
+                    + (query[j, 2] - query[j, 0] + 1) * (query[j, 3] - query[j, 1] + 1)
+                    - iw * ih
+                )
+                out[i, j] = iw * ih / ua
+    return out
+
+
+class TestOverlaps:
+    def test_identical_box(self):
+        b = jnp.array([[0.0, 0.0, 9.0, 9.0]])
+        iou = bbox_overlaps(b, b)
+        assert np.allclose(iou, 1.0)
+
+    def test_disjoint(self):
+        a = jnp.array([[0.0, 0.0, 9.0, 9.0]])
+        b = jnp.array([[20.0, 20.0, 29.0, 29.0]])
+        assert np.allclose(bbox_overlaps(a, b), 0.0)
+
+    def test_half_overlap_inclusive(self):
+        # [0,9]x[0,9] (area 100) vs [5,14]x[0,9] (area 100): inter 5x10=50,
+        # union 150 -> IoU 1/3 under the inclusive convention.
+        a = jnp.array([[0.0, 0.0, 9.0, 9.0]])
+        b = jnp.array([[5.0, 0.0, 14.0, 9.0]])
+        assert np.allclose(bbox_overlaps(a, b), 50.0 / 150.0)
+
+    def test_vs_numpy_random(self, rng):
+        boxes = rng.uniform(0, 100, (40, 4))
+        boxes[:, 2:] += boxes[:, :2]
+        query = rng.uniform(0, 100, (23, 4))
+        query[:, 2:] += query[:, :2]
+        got = np.asarray(bbox_overlaps(jnp.array(boxes), jnp.array(query)))
+        want = np_bbox_overlaps(boxes, query)
+        assert np.allclose(got, want, atol=1e-5)
+
+
+class TestTransformRoundTrip:
+    def test_transform_identity(self):
+        b = jnp.array([[10.0, 10.0, 50.0, 30.0]])
+        d = bbox_transform(b, b)
+        assert np.allclose(d, 0.0, atol=1e-6)
+
+    def test_pred_inverts_transform(self, rng):
+        ex = rng.uniform(0, 200, (30, 4)).astype(np.float32)
+        ex[:, 2:] = ex[:, :2] + np.abs(ex[:, 2:] - ex[:, :2]) + 5
+        gt = rng.uniform(0, 200, (30, 4)).astype(np.float32)
+        gt[:, 2:] = gt[:, :2] + np.abs(gt[:, 2:] - gt[:, :2]) + 5
+        deltas = bbox_transform(jnp.array(ex), jnp.array(gt))
+        back = bbox_pred(jnp.array(ex), deltas)
+        assert np.allclose(back, gt, atol=1e-2)
+
+    def test_known_values(self):
+        # ex box (0,0,9,9): w=h=10, ctr (4.5,4.5).
+        # gt box (5,5,14,14): w=h=10, ctr (9.5,9.5).
+        # dx = 5/10 = 0.5, dw = log(1) = 0.
+        ex = jnp.array([[0.0, 0.0, 9.0, 9.0]])
+        gt = jnp.array([[5.0, 5.0, 14.0, 14.0]])
+        d = np.asarray(bbox_transform(ex, gt))
+        assert np.allclose(d, [[0.5, 0.5, 0.0, 0.0]], atol=1e-6)
+
+    def test_multiclass_pred(self):
+        # K=2 classes: deltas (N, 8); each group decoded against the same box.
+        ex = jnp.array([[0.0, 0.0, 9.0, 9.0]])
+        deltas = jnp.array([[0.0] * 4 + [0.5, 0.5, 0.0, 0.0]])
+        out = np.asarray(bbox_pred(ex, deltas))
+        assert np.allclose(out[0, :4], [0, 0, 9, 9], atol=1e-5)
+        assert np.allclose(out[0, 4:], [5, 5, 14, 14], atol=1e-5)
+
+
+class TestClip:
+    def test_clip(self):
+        b = jnp.array([[-5.0, -5.0, 120.0, 150.0]])
+        out = np.asarray(clip_boxes(b, (100.0, 110.0)))
+        assert np.allclose(out, [[0.0, 0.0, 109.0, 99.0]])
+
+    def test_jit_consistency(self):
+        b = jnp.array([[-5.0, 3.0, 120.0, 90.0]])
+        eager = clip_boxes(b, (100.0, 110.0))
+        jitted = jax.jit(lambda x: clip_boxes(x, (100.0, 110.0)))(b)
+        assert np.allclose(eager, jitted)

@@ -1,0 +1,98 @@
+"""NMS vs a pure-python greedy reference (the reference's rcnn/processing/nms.py
+``nms()`` semantics: sort by score, suppress IoU > thresh, inclusive widths)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mx_rcnn_tpu.ops.nms import nms, nms_bitmask
+
+
+def py_greedy_nms(dets, thresh):
+    """Reference python NMS: dets (N,5) [x1,y1,x2,y2,score] -> keep indices."""
+    x1, y1, x2, y2, scores = dets[:, 0], dets[:, 1], dets[:, 2], dets[:, 3], dets[:, 4]
+    areas = (x2 - x1 + 1) * (y2 - y1 + 1)
+    order = scores.argsort()[::-1]
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1[order[1:]])
+        yy1 = np.maximum(y1[i], y1[order[1:]])
+        xx2 = np.minimum(x2[i], x2[order[1:]])
+        yy2 = np.minimum(y2[i], y2[order[1:]])
+        w = np.maximum(0.0, xx2 - xx1 + 1)
+        h = np.maximum(0.0, yy2 - yy1 + 1)
+        inter = w * h
+        ovr = inter / (areas[i] + areas[order[1:]] - inter)
+        inds = np.where(ovr <= thresh)[0]
+        order = order[inds + 1]
+    return keep
+
+
+def random_dets(rng, n):
+    boxes = rng.uniform(0, 80, (n, 4)).astype(np.float32)
+    boxes[:, 2:] = boxes[:, :2] + rng.uniform(5, 60, (n, 2))
+    # Distinct scores avoid tie-order ambiguity between implementations.
+    scores = rng.permutation(n).astype(np.float32) / n + 0.01
+    return boxes, scores
+
+
+@pytest.mark.parametrize("impl", [nms, nms_bitmask])
+@pytest.mark.parametrize("thresh", [0.3, 0.5, 0.7])
+def test_matches_python_reference(rng, impl, thresh):
+    boxes, scores = random_dets(rng, 60)
+    valid = np.ones(60, bool)
+    keep_idx, keep_valid = impl(
+        jnp.array(boxes), jnp.array(scores), jnp.array(valid), thresh, 60
+    )
+    got = np.asarray(keep_idx)[np.asarray(keep_valid)]
+    want = py_greedy_nms(np.hstack([boxes, scores[:, None]]), thresh)
+    assert got.tolist() == list(want)
+
+
+@pytest.mark.parametrize("impl", [nms, nms_bitmask])
+def test_respects_validity_mask(rng, impl):
+    boxes, scores = random_dets(rng, 30)
+    valid = np.zeros(30, bool)
+    valid[:10] = True
+    keep_idx, keep_valid = impl(
+        jnp.array(boxes), jnp.array(scores), jnp.array(valid), 0.5, 30
+    )
+    got = set(np.asarray(keep_idx)[np.asarray(keep_valid)].tolist())
+    assert got <= set(range(10))
+    want = py_greedy_nms(np.hstack([boxes[:10], scores[:10, None]]), 0.5)
+    assert got == set(want)
+
+
+@pytest.mark.parametrize("impl", [nms, nms_bitmask])
+def test_max_output_truncates(rng, impl):
+    boxes, scores = random_dets(rng, 50)
+    valid = np.ones(50, bool)
+    keep_idx, keep_valid = impl(
+        jnp.array(boxes), jnp.array(scores), jnp.array(valid), 0.9, 5
+    )
+    assert keep_idx.shape == (5,)
+    want = py_greedy_nms(np.hstack([boxes, scores[:, None]]), 0.9)[:5]
+    got = np.asarray(keep_idx)[np.asarray(keep_valid)]
+    assert got.tolist() == want
+
+
+@pytest.mark.parametrize("impl", [nms, nms_bitmask])
+def test_all_invalid(impl):
+    boxes = jnp.zeros((8, 4))
+    scores = jnp.zeros((8,))
+    valid = jnp.zeros((8,), bool)
+    _, keep_valid = impl(boxes, scores, valid, 0.5, 4)
+    assert not np.asarray(keep_valid).any()
+
+
+def test_jit_consistency(rng):
+    boxes, scores = random_dets(rng, 40)
+    valid = np.ones(40, bool)
+    args = (jnp.array(boxes), jnp.array(scores), jnp.array(valid))
+    eager = nms_bitmask(*args, 0.5, 20)
+    jitted = jax.jit(lambda b, s, v: nms_bitmask(b, s, v, 0.5, 20))(*args)
+    assert np.array_equal(eager[0], jitted[0])
+    assert np.array_equal(eager[1], jitted[1])

@@ -1,0 +1,73 @@
+"""ROIAlign / ROIPool vs numpy references and invariants."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.roi_align import roi_align, roi_pool
+
+
+def test_roi_align_constant_map():
+    # Pooling a constant feature map must return the constant.
+    feat = jnp.full((1, 16, 16, 3), 2.5)
+    rois = jnp.array([[0.0, 8.0, 8.0, 120.0, 120.0]])
+    out = roi_align(feat, rois, output_size=7, spatial_scale=1.0 / 16.0)
+    assert out.shape == (1, 7, 7, 3)
+    assert np.allclose(out, 2.5, atol=1e-5)
+
+
+def test_roi_align_linear_ramp():
+    # f(x,y) = x is reproduced exactly by bilinear sampling + averaging.
+    w = 32
+    ramp = jnp.tile(jnp.arange(w, dtype=jnp.float32)[None, :, None], (w, 1, 1))
+    feat = ramp[None]  # (1, 32, 32, 1)
+    # roi covering feature cols [4, 28] at scale 1 (image == feature coords).
+    rois = jnp.array([[0.0, 4.0, 4.0, 28.0, 28.0]])
+    out = roi_align(feat, rois, output_size=4, spatial_scale=1.0, sampling_ratio=2)
+    # bin width = 24/4 = 6; bin k spans x in [4+6k, 4+6k+6); mean sample x
+    # = 4 + 6k + 3 = centre of the bin.
+    want = np.array([7.0, 13.0, 19.0, 25.0])
+    assert np.allclose(np.asarray(out)[0, 2, :, 0], want, atol=1e-4)
+
+
+def test_roi_align_batch_index():
+    feat = jnp.stack([jnp.zeros((8, 8, 1)), jnp.ones((8, 8, 1))])  # (2,8,8,1)
+    rois = jnp.array([[0.0, 0.0, 0.0, 7.0, 7.0], [1.0, 0.0, 0.0, 7.0, 7.0]])
+    out = roi_align(feat, rois, output_size=2, spatial_scale=1.0)
+    assert np.allclose(out[0], 0.0)
+    assert np.allclose(out[1], 1.0)
+
+
+def test_roi_pool_max_semantics():
+    # Single hot pixel: max pool must find it in the covering bin.
+    feat = np.zeros((1, 8, 8, 1), np.float32)
+    feat[0, 5, 6, 0] = 9.0
+    rois = jnp.array([[0.0, 0.0, 0.0, 7.0, 7.0]])
+    out = np.asarray(roi_pool(jnp.array(feat), rois, output_size=2, spatial_scale=1.0))
+    # Bin (1,1) covers rows/cols [4,8): contains (5,6).
+    assert out[0, 1, 1, 0] == 9.0
+    assert out[0, 0, 0, 0] == 0.0
+
+
+def test_roi_pool_scale_quantization():
+    # spatial_scale 1/16: image box (0,0,31,31) -> feature box (0,0,2,2).
+    feat = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    rois = jnp.array([[0.0, 0.0, 0.0, 31.0, 31.0]])
+    out = np.asarray(
+        roi_pool(jnp.array(feat), rois, output_size=1, spatial_scale=1.0 / 16.0)
+    )
+    # max over rows/cols 0..2 = feat[2,2] = 10.
+    assert out[0, 0, 0, 0] == 10.0
+
+
+def test_jit_and_grad():
+    feat = jnp.ones((1, 8, 8, 2))
+    rois = jnp.array([[0.0, 2.0, 2.0, 6.0, 6.0]])
+
+    def f(x):
+        return roi_align(x, rois, output_size=2, spatial_scale=1.0).sum()
+
+    g = jax.grad(f)(feat)
+    assert g.shape == feat.shape
+    # Gradient mass = number of pooled outputs (mean weights sum to 1/bin).
+    assert np.isclose(float(g.sum()), 2 * 2 * 2, atol=1e-4)

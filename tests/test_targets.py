@@ -1,0 +1,168 @@
+"""Tests for assign_anchor / sample_rois vs reference semantics."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mx_rcnn_tpu.ops.anchors import anchor_grid
+from mx_rcnn_tpu.targets.rpn_targets import assign_anchor
+from mx_rcnn_tpu.targets.rcnn_targets import sample_rois
+
+
+def pad_gt(boxes, g=8):
+    out = np.zeros((g, 4), np.float32)
+    valid = np.zeros((g,), bool)
+    if len(boxes):
+        out[: len(boxes)] = boxes
+        valid[: len(boxes)] = True
+    return jnp.array(out), jnp.array(valid)
+
+
+class TestAssignAnchor:
+    def setup_method(self):
+        self.anchors = jnp.array(anchor_grid(16, 16, stride=16))
+        self.im_info = jnp.array([256.0, 256.0, 1.0])
+        self.key = jax.random.PRNGKey(0)
+
+    def test_positive_on_exact_match(self):
+        # gt equal to one anchor -> that anchor must be labeled 1.
+        a = np.asarray(self.anchors)
+        inside = (a[:, 0] >= 0) & (a[:, 1] >= 0) & (a[:, 2] < 256) & (a[:, 3] < 256)
+        idx = int(np.nonzero(inside)[0][0])
+        gt, gtv = pad_gt([a[idx]])
+        t = assign_anchor(self.anchors, gt, gtv, self.im_info, self.key)
+        assert int(t.labels[idx]) == 1
+        # Its regression target is ~0 and weighted.
+        assert np.allclose(t.bbox_targets[idx], 0.0, atol=1e-5)
+        assert np.allclose(t.bbox_weights[idx], 1.0)
+
+    def test_outside_anchors_ignored(self):
+        gt, gtv = pad_gt([[10, 10, 100, 100]])
+        t = assign_anchor(self.anchors, gt, gtv, self.im_info, self.key)
+        a = np.asarray(self.anchors)
+        outside = ~(
+            (a[:, 0] >= 0) & (a[:, 1] >= 0) & (a[:, 2] < 256) & (a[:, 3] < 256)
+        )
+        assert np.all(np.asarray(t.labels)[outside] == -1)
+        assert np.all(np.asarray(t.bbox_weights)[outside] == 0)
+
+    def test_batch_size_cap(self):
+        gt, gtv = pad_gt([[10, 10, 100, 100], [120, 120, 240, 240]])
+        t = assign_anchor(
+            self.anchors, gt, gtv, self.im_info, self.key, rpn_batch_size=256
+        )
+        labels = np.asarray(t.labels)
+        assert (labels >= 0).sum() <= 256
+        assert (labels == 1).sum() <= 128
+        assert (labels == 1).sum() >= 1  # best-per-gt guarantee
+
+    def test_no_gt_all_background(self):
+        gt, gtv = pad_gt([])
+        t = assign_anchor(self.anchors, gt, gtv, self.im_info, self.key)
+        labels = np.asarray(t.labels)
+        assert (labels == 1).sum() == 0
+        # All inside anchors become negatives, capped at the 256 batch size.
+        a = np.asarray(self.anchors)
+        inside = (
+            (a[:, 0] >= 0) & (a[:, 1] >= 0) & (a[:, 2] < 256) & (a[:, 3] < 256)
+        ).sum()
+        assert (labels == 0).sum() == min(inside, 256)
+
+    def test_jit_matches_eager(self):
+        gt, gtv = pad_gt([[10, 10, 100, 100]])
+        f = lambda k: assign_anchor(self.anchors, gt, gtv, self.im_info, k)
+        eager = f(self.key)
+        jitted = jax.jit(f)(self.key)
+        assert np.array_equal(eager.labels, jitted.labels)
+        assert np.allclose(eager.bbox_targets, jitted.bbox_targets)
+
+
+class TestSampleRois:
+    NUM_CLASSES = 5
+
+    def _run(self, rois, roi_valid, gts, classes, key=0, **kw):
+        g = 8
+        gt, gtv = pad_gt(gts, g)
+        cls = np.zeros((g,), np.int32)
+        cls[: len(classes)] = classes
+        return sample_rois(
+            jnp.array(rois, jnp.float32),
+            jnp.array(roi_valid),
+            gt,
+            jnp.array(cls),
+            gtv,
+            jax.random.PRNGKey(key),
+            num_classes=self.NUM_CLASSES,
+            batch_rois=16,
+            **kw,
+        )
+
+    def test_gt_appended_as_fg(self):
+        # No proposals overlap gt, but the appended gt itself is a perfect fg.
+        rois = np.array([[200, 200, 220, 220]] * 4, np.float32)
+        valid = np.ones(4, bool)
+        s = self._run(rois, valid, [[10, 10, 50, 50]], [3])
+        labels = np.asarray(s.labels)
+        fg = np.asarray(s.fg_mask)
+        assert fg.sum() >= 1
+        assert np.all(labels[fg] == 3)
+        # fg rois are the gt box itself.
+        assert np.allclose(np.asarray(s.rois)[fg][0], [10, 10, 50, 50])
+
+    def test_fg_fraction_cap(self):
+        # All 20 proposals identical to gt -> fg candidates abound; cap at 25%.
+        rois = np.tile(np.array([[10, 10, 50, 50]], np.float32), (20, 1))
+        s = self._run(rois, np.ones(20, bool), [[10, 10, 50, 50]], [2])
+        assert np.asarray(s.fg_mask).sum() == 4  # 0.25 * 16
+        assert np.asarray(s.labels)[np.asarray(s.fg_mask)].tolist() == [2] * 4
+
+    def test_bg_labels_zero_weights_zero(self):
+        rois = np.array([[200, 200, 240, 240]] * 10, np.float32)
+        s = self._run(rois, np.ones(10, bool), [[10, 10, 50, 50]], [1])
+        labels = np.asarray(s.labels)
+        bg = np.asarray(s.valid) & ~np.asarray(s.fg_mask)
+        assert np.all(labels[bg] == 0)
+        w = np.asarray(s.bbox_weights)
+        assert np.all(w[bg] == 0)
+
+    def test_target_normalization_and_expansion(self):
+        rois = np.array([[10, 10, 50, 50]], np.float32)  # exact gt match
+        s = self._run(
+            rois, np.ones(1, bool), [[10, 10, 50, 50]], [2],
+            bbox_means=(0.1, 0.1, 0.1, 0.1), bbox_stds=(0.2, 0.2, 0.2, 0.2),
+        )
+        fg = np.asarray(s.fg_mask)
+        t = np.asarray(s.bbox_targets)[fg][0].reshape(self.NUM_CLASSES, 4)
+        # Raw delta 0 -> normalized (0 - 0.1)/0.2 = -0.5, only in class-2 block.
+        assert np.allclose(t[2], -0.5, atol=1e-5)
+        assert np.allclose(t[[0, 1, 3, 4]], 0.0)
+        w = np.asarray(s.bbox_weights)[fg][0].reshape(self.NUM_CLASSES, 4)
+        assert np.allclose(w[2], 1.0)
+        assert np.allclose(w[[0, 1, 3, 4]], 0.0)
+
+    def test_respects_roi_validity(self):
+        # Invalid proposals must never be sampled even if they overlap gt.
+        rois = np.tile(np.array([[10, 10, 50, 50]], np.float32), (6, 1))
+        valid = np.zeros(6, bool)
+        s = self._run(rois, valid, [[10, 10, 50, 50]], [1])
+        # Only the appended gt can be fg.
+        assert np.asarray(s.fg_mask).sum() == 1
+
+    def test_jit_matches_eager(self):
+        rois = np.random.RandomState(1).uniform(0, 200, (12, 4)).astype(np.float32)
+        rois[:, 2:] += rois[:, :2]
+        gt, gtv = pad_gt([[10, 10, 80, 80]], 8)
+        cls = jnp.array([1] + [0] * 7, jnp.int32)
+        valid = jnp.ones(12, bool)
+
+        def f(k):
+            return sample_rois(
+                jnp.array(rois), valid, gt, cls, gtv, k,
+                num_classes=self.NUM_CLASSES, batch_rois=16,
+            )
+
+        key = jax.random.PRNGKey(3)
+        eager, jitted = f(key), jax.jit(f)(key)
+        assert np.array_equal(eager.labels, jitted.labels)
+        assert np.allclose(eager.bbox_targets, jitted.bbox_targets)
