@@ -1,0 +1,92 @@
+"""Benchmark: Faster R-CNN train-step throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference never published throughput (BASELINE.md: Speedometer logs
+only), so vs_baseline is measured against a fixed reference point of
+5.0 img/s/GPU — a generous estimate of the classic implementation's
+ResNet-101 COCO training speed on a 2017 P100 (README-era hardware), used
+solely to make the ratio meaningful across rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+REFERENCE_IMG_S = 5.0  # estimated reference img/s/GPU (see module docstring)
+
+
+def main():
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.models.faster_rcnn import build_model, init_params
+    from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
+    from mx_rcnn_tpu.train.optimizer import build_optimizer
+    from mx_rcnn_tpu.train.step import create_train_state, make_train_step
+
+    # Flagship config: ResNet-101, COCO class count, (600,1000)-scale padded
+    # canvas, full proposal counts — the reference's headline training shape.
+    cfg = generate_config(
+        "resnet101", "coco",
+        **{"image.pad_shape": (640, 1024), "train.batch_images": 1},
+    )
+    b = cfg.train.batch_images
+    h, w = cfg.image.pad_shape
+    g = cfg.train.max_gt_boxes
+
+    rs = np.random.RandomState(0)
+    n_boxes = 8
+    boxes = np.zeros((b, g, 4), np.float32)
+    for i in range(b):
+        x1 = rs.uniform(0, w - 200, n_boxes)
+        y1 = rs.uniform(0, h - 200, n_boxes)
+        boxes[i, :n_boxes] = np.stack(
+            [x1, y1, x1 + rs.uniform(50, 199, n_boxes),
+             y1 + rs.uniform(50, 199, n_boxes)], axis=1)
+    valid = np.zeros((b, g), bool)
+    valid[:, :n_boxes] = True
+    classes = np.zeros((b, g), np.int32)
+    classes[:, :n_boxes] = rs.randint(1, 81, (b, n_boxes))
+    batch = {
+        "image": rs.randn(b, h, w, 3).astype(np.float32),
+        "im_info": np.asarray([[600, 1000, 1.0]] * b, np.float32),
+        "gt_boxes": boxes,
+        "gt_classes": classes,
+        "gt_valid": valid,
+    }
+
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    tx = build_optimizer(cfg, params, steps_per_epoch=1000)
+    state = create_train_state(params, tx)
+    mesh = create_mesh(str(jax.device_count()))
+    step_fn = make_train_step(model, cfg, mesh=mesh)
+    batch = shard_batch(batch, mesh)
+
+    rng = jax.random.PRNGKey(1)
+    # warmup/compile
+    state, metrics = step_fn(state, batch, rng)
+    jax.block_until_ready(metrics["TotalLoss"])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        rng, k = jax.random.split(rng)
+        state, metrics = step_fn(state, batch, k)
+    jax.block_until_ready(metrics["TotalLoss"])
+    dt = time.perf_counter() - t0
+    img_s = iters * b / dt
+    per_chip = img_s / jax.device_count()
+    print(json.dumps({
+        "metric": "faster_rcnn_r101_coco_train_img_per_sec_per_chip",
+        "value": round(per_chip, 3),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / REFERENCE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

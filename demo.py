@@ -1,0 +1,82 @@
+"""Single-image inference demo (reference entry point: demo.py).
+
+    python demo.py --network resnet101 --dataset coco --prefix model/e2e \
+        --epoch 10 --image street.jpg --out vis.jpg
+
+With no --image, runs on a generated synthetic scene (offline smoke test).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data.image import (
+    load_image, pad_image, resize_image, transform_image)
+from mx_rcnn_tpu.evaluation.tester import Predictor, im_detect
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models.faster_rcnn import build_model, init_params
+from mx_rcnn_tpu.train.checkpoint import load_checkpoint
+from mx_rcnn_tpu.utils.vis import draw_detections
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="Faster R-CNN demo")
+    p.add_argument("--network", default="resnet101")
+    p.add_argument("--dataset", default="coco")
+    p.add_argument("--prefix", default=None,
+                   help="checkpoint prefix; random weights if omitted")
+    p.add_argument("--epoch", type=int, default=10)
+    p.add_argument("--image", default=None)
+    p.add_argument("--out", default="demo_out.jpg")
+    p.add_argument("--thresh", type=float, default=0.5)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    cfg = generate_config(args.network, args.dataset)
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    if args.prefix:
+        params, _ = load_checkpoint(
+            args.prefix, args.epoch, template={"params": params},
+            means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+            num_classes=cfg.dataset.num_classes)
+
+    if args.image:
+        raw = load_image(args.image)
+    else:
+        from mx_rcnn_tpu.data.datasets import SyntheticDataset
+        raw = SyntheticDataset("demo", num_images=1)._gen(0)[0]
+        logger.info("no --image given; using a synthetic scene")
+
+    target, max_size = cfg.image.scales[0]
+    img, scale = resize_image(raw, target, max_size)
+    h, w = img.shape[:2]
+    img_t = pad_image(
+        transform_image(img, cfg.image.pixel_means, cfg.image.pixel_stds),
+        cfg.image.pad_shape)
+    im_info = np.asarray([[h, w, scale]], np.float32)
+
+    predictor = Predictor(model, params, cfg)
+    dets = im_detect(predictor, img_t[None], im_info, scale)[0]
+    dets = dets[dets[:, 1] >= args.thresh]
+    logger.info("%d detections above %.2f", len(dets), args.thresh)
+
+    class_names = cfg.dataset.class_names or tuple(
+        str(i) for i in range(cfg.dataset.num_classes))
+    vis = draw_detections(raw.astype(np.uint8), dets, class_names)
+    try:
+        from PIL import Image
+        Image.fromarray(vis).save(args.out)
+        logger.info("wrote %s", args.out)
+    except Exception as exc:  # pragma: no cover
+        logger.warning("could not save visualization: %s", exc)
+
+
+if __name__ == "__main__":
+    main()

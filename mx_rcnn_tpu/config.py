@@ -115,8 +115,12 @@ class TestConfig:
     nms_thresh: float = 0.3
     score_thresh: float = 0.05
     max_per_image: int = 100
-    # Proposal-generation mode (alternate training / Fast R-CNN).
+    # Proposal-generation mode (alternate training / Fast R-CNN) — the
+    # reference's TEST.PROPOSAL_* knobs: dump MORE proposals (→2000) than the
+    # detection path keeps (→300).
     proposal_nms_thresh: float = 0.7
+    proposal_pre_nms_top_n: int = 20000
+    proposal_post_nms_top_n: int = 2000
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,24 @@
+"""Data pipeline — host-side image IO, batching, dataset (roidb) handling.
+
+Reference layers L3/L4/L7 (SURVEY.md §2): rcnn/io/image.py, rcnn/core/loader.py
+(AnchorLoader/TestLoader), rcnn/dataset/*. TPU delta: target assignment
+(assign_anchor / sample_rois) moved INTO the jitted step (targets/), so the
+host loader only decodes, resizes, pads to static shapes, and prefetches.
+"""
+
+from mx_rcnn_tpu.data.image import (
+    load_image,
+    resize_image,
+    transform_image,
+    pad_image,
+)
+from mx_rcnn_tpu.data.loader import AnchorLoader, TestLoader
+
+__all__ = [
+    "load_image",
+    "resize_image",
+    "transform_image",
+    "pad_image",
+    "AnchorLoader",
+    "TestLoader",
+]

@@ -1,0 +1,28 @@
+"""Datasets — roidb construction, caching, evaluation.
+
+Reference layer L7 (SURVEY.md §2): rcnn/dataset/ (imdb.py, pascal_voc.py,
+pascal_voc_eval.py, coco.py). Plus a synthetic dataset (no reference analog)
+so the fully-offline CI can exercise the end-to-end path.
+"""
+
+from mx_rcnn_tpu.data.datasets.imdb import IMDB
+from mx_rcnn_tpu.data.datasets.pascal_voc import PascalVOC
+from mx_rcnn_tpu.data.datasets.coco import COCODataset
+from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
+
+
+def get_dataset(name: str, image_set: str, root_path: str, dataset_path: str,
+                **kwargs) -> IMDB:
+    """Dataset registry (reference: the eval(dataset)(...) dispatch in
+    train_end2end.py / rcnn/utils/load_data.py)."""
+    registry = {
+        "PascalVOC": PascalVOC,
+        "coco": COCODataset,
+        "synthetic": SyntheticDataset,
+    }
+    if name not in registry:
+        raise KeyError(f"unknown dataset {name!r}; have {sorted(registry)}")
+    return registry[name](image_set, root_path, dataset_path, **kwargs)
+
+
+__all__ = ["IMDB", "PascalVOC", "COCODataset", "SyntheticDataset", "get_dataset"]

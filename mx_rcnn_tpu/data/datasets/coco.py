@@ -1,0 +1,127 @@
+"""COCO dataset.
+
+Reference: rcnn/dataset/coco.py, which drives the vendored
+rcnn/pycocotools COCO api. pycocotools is not installed in this environment
+(SURVEY.md §8), so the annotation index is built directly from the
+instances_*.json here, and evaluation delegates to the in-repo
+evaluation/coco_eval.py reimplementation of COCOeval's bbox protocol.
+
+COCO boxes are (x, y, w, h) EXCLUSIVE; converted on load to the framework's
+inclusive (x1, y1, x2, y2) via x2 = x + w − 1 (matching the reference's
+coco.py gt load which does x2 = x1 + w - 1 with clipping).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from mx_rcnn_tpu.data.datasets.imdb import IMDB
+from mx_rcnn_tpu.logger import logger
+
+
+class COCODataset(IMDB):
+    def __init__(self, image_set: str, root_path: str = "data",
+                 dataset_path: str = "data/coco"):
+        super().__init__("coco", image_set, root_path, dataset_path)
+        self.anno_file = os.path.join(
+            dataset_path, "annotations", f"instances_{image_set}.json")
+        self._index = None  # lazy
+
+    def gt_roidb(self):
+        # The class list lives in the annotation json; make sure it is
+        # loaded even when the roidb comes from the pickle cache (otherwise
+        # num_classes would be 0 on cache hits).
+        self._load_index()
+        return super().gt_roidb()
+
+    def _load_index(self):
+        if self._index is not None:
+            return self._index
+        with open(self.anno_file) as f:
+            data = json.load(f)
+        cats = sorted(data["categories"], key=lambda c: c["id"])
+        # contiguous class ids 1..80 (reference: coco.py category mapping)
+        self.classes = ("__background__",) + tuple(c["name"] for c in cats)
+        self._cat_to_class = {c["id"]: i + 1 for i, c in enumerate(cats)}
+        self._class_to_cat = {i + 1: c["id"] for i, c in enumerate(cats)}
+        images = {im["id"]: im for im in data["images"]}
+        anns_by_image: Dict[int, List] = {}
+        for ann in data["annotations"]:
+            if ann.get("iscrowd", 0):
+                continue  # reference skips crowd boxes for training
+            anns_by_image.setdefault(ann["image_id"], []).append(ann)
+        self._index = (images, anns_by_image, data)
+        self.num_images = len(images)
+        return self._index
+
+    def _image_path(self, im: Dict) -> str:
+        return os.path.join(self.dataset_path, self.image_set, im["file_name"])
+
+    def _load_gt_roidb(self) -> List[Dict]:
+        images, anns_by_image, _ = self._load_index()
+        roidb = []
+        for im_id in sorted(images):
+            im = images[im_id]
+            anns = anns_by_image.get(im_id, [])
+            boxes, classes = [], []
+            w, h = im["width"], im["height"]
+            for a in anns:
+                x, y, bw, bh = a["bbox"]
+                x1 = max(0.0, x)
+                y1 = max(0.0, y)
+                x2 = min(w - 1.0, x + max(0.0, bw - 1))
+                y2 = min(h - 1.0, y + max(0.0, bh - 1))
+                if a.get("area", 0) > 0 and x2 >= x1 and y2 >= y1:
+                    boxes.append([x1, y1, x2, y2])
+                    classes.append(self._cat_to_class[a["category_id"]])
+            roidb.append({
+                "index": im_id,
+                "image": self._image_path(im),
+                "height": h,
+                "width": w,
+                "boxes": np.asarray(boxes, np.float32).reshape(-1, 4),
+                "gt_classes": np.asarray(classes, np.int32),
+                "flipped": False,
+            })
+        return roidb
+
+    def results_to_json(self, all_boxes) -> List[Dict]:
+        """Detections → COCO results format (reference: coco.py
+        _write_coco_results writing detections json; xywh EXCLUSIVE)."""
+        images, _, _ = self._load_index()
+        image_ids = sorted(images)
+        results = []
+        for c in range(1, self.num_classes):
+            cat_id = self._class_to_cat[c]
+            for i, im_id in enumerate(image_ids):
+                dets = all_boxes[c][i]
+                if dets is None or len(dets) == 0:
+                    continue
+                for d in np.asarray(dets):
+                    results.append({
+                        "image_id": int(im_id),
+                        "category_id": int(cat_id),
+                        "bbox": [float(d[0]), float(d[1]),
+                                 float(d[2] - d[0] + 1), float(d[3] - d[1] + 1)],
+                        "score": float(d[4]),
+                    })
+        return results
+
+    def evaluate_detections(self, all_boxes, out_json: str = None, **kwargs):
+        """COCO bbox mAP@[.5:.95] via the in-repo COCOeval reimplementation."""
+        from mx_rcnn_tpu.evaluation.coco_eval import COCOEval
+
+        results = self.results_to_json(all_boxes)
+        if out_json:
+            os.makedirs(os.path.dirname(out_json) or ".", exist_ok=True)
+            with open(out_json, "w") as f:
+                json.dump(results, f)
+            logger.info("wrote %d detections to %s", len(results), out_json)
+        _, _, data = self._load_index()
+        evaluator = COCOEval(data, results)
+        stats = evaluator.summarize()
+        return stats

@@ -1,0 +1,123 @@
+"""IMDB — image-database ABC with roidb caching and augmentation.
+
+Reference: rcnn/dataset/imdb.py — gt_roidb with pickle cache under
+data/cache/, append_flipped_images (x-mirror, doubles the roidb),
+proposal-roidb loading/merging for the alternate/Fast paths, and the
+evaluate_detections contract.
+
+roidb record schema (all datasets):
+  image: str path (or image_data: ndarray for synthetic)
+  height, width: int
+  boxes: (n, 4) float32 x1,y1,x2,y2
+  gt_classes: (n,) int32 (1..C-1; background never appears)
+  flipped: bool
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.logger import logger
+
+
+class IMDB:
+    def __init__(self, name: str, image_set: str, root_path: str,
+                 dataset_path: str):
+        self.name = f"{name}_{image_set}"
+        self.image_set = image_set
+        self.root_path = root_path
+        self.dataset_path = dataset_path
+        self.classes: tuple = ()
+        self.num_images = 0
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.classes)
+
+    @property
+    def cache_path(self) -> str:
+        path = os.path.join(self.root_path, "cache")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    # -- roidb ------------------------------------------------------------
+
+    def gt_roidb(self) -> List[Dict]:
+        """Ground-truth roidb with a pickle cache (reference behavior)."""
+        cache_file = os.path.join(self.cache_path, f"{self.name}_gt_roidb.pkl")
+        if os.path.exists(cache_file):
+            with open(cache_file, "rb") as f:
+                roidb = pickle.load(f)
+            logger.info("%s gt roidb loaded from %s", self.name, cache_file)
+            return roidb
+        roidb = self._load_gt_roidb()
+        with open(cache_file, "wb") as f:
+            pickle.dump(roidb, f, pickle.HIGHEST_PROTOCOL)
+        logger.info("%s wrote gt roidb to %s", self.name, cache_file)
+        return roidb
+
+    def _load_gt_roidb(self) -> List[Dict]:
+        raise NotImplementedError
+
+    def append_flipped_images(self, roidb: List[Dict]) -> List[Dict]:
+        """Double the roidb with flipped copies. The pixel flip happens at
+        load time (data/loader.py); here only the flag + box bookkeeping
+        (reference: imdb.py append_flipped_images)."""
+        flipped = []
+        for entry in roidb:
+            e = dict(entry)
+            e["flipped"] = True
+            flipped.append(e)
+        logger.info("%s appended flipped images: %d -> %d", self.name,
+                    len(roidb), len(roidb) + len(flipped))
+        return roidb + flipped
+
+    # -- proposal roidb (alternate training / Fast R-CNN path) -----------
+
+    def load_rpn_data(self, rpn_file: str) -> List[np.ndarray]:
+        """Load per-image proposal arrays saved by generate_proposals
+        (reference: imdb.load_rpn_data reading rpn_data/*_rpn.pkl)."""
+        with open(rpn_file, "rb") as f:
+            return pickle.load(f)
+
+    def rpn_roidb(self, gt_roidb: List[Dict], rpn_file: str) -> List[Dict]:
+        """Merge RPN proposals with gt into a Fast-RCNN-trainable roidb
+        (reference: imdb.rpn_roidb + merge_roidbs)."""
+        boxes_list = self.load_rpn_data(rpn_file)
+        assert len(boxes_list) == len(gt_roidb), (
+            f"proposal count {len(boxes_list)} != roidb {len(gt_roidb)}")
+        out = []
+        for entry, prop in zip(gt_roidb, boxes_list):
+            e = dict(entry)
+            e["proposals"] = prop[:, :4].astype(np.float32)
+            out.append(e)
+        return out
+
+    # -- evaluation -------------------------------------------------------
+
+    def evaluate_detections(self, all_boxes: List[List[np.ndarray]],
+                            **kwargs) -> Dict[str, float]:
+        """all_boxes[class][image] = (n, 5) [x1,y1,x2,y2,score] in ORIGINAL
+        image coordinates. Returns metric dict (e.g. {'mAP': ...})."""
+        raise NotImplementedError
+
+
+def filter_roidb(roidb: List[Dict]) -> List[Dict]:
+    """Drop images without valid gt (reference:
+    rcnn/utils/load_data.py::filter_roidb)."""
+    out = [r for r in roidb if len(r["boxes"]) > 0]
+    logger.info("filter_roidb: %d -> %d images", len(roidb), len(out))
+    return out
+
+
+def merge_roidb(roidbs: List[List[Dict]]) -> List[Dict]:
+    """Concatenate roidbs from multiple image sets (reference:
+    load_data.py::merge_roidb for '07+12'-style sets)."""
+    out: List[Dict] = []
+    for r in roidbs:
+        out.extend(r)
+    return out

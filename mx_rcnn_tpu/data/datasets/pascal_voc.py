@@ -1,0 +1,133 @@
+"""PASCAL VOC dataset.
+
+Reference: rcnn/dataset/pascal_voc.py — VOCdevkit layout, XML annotation
+parsing, imageset lists, comp4 result-file writing, voc_eval per class.
+image_set strings are '<year>_<set>' (e.g. '2007_trainval'); the reference's
+'07+12' multi-set merging happens above this class
+(rcnn/utils/load_data.py::merge_roidb → data/datasets/imdb.merge_roidb).
+"""
+
+from __future__ import annotations
+
+import os
+import xml.etree.ElementTree as ET
+from typing import Dict, List
+
+import numpy as np
+
+from mx_rcnn_tpu.config import VOC_CLASSES
+from mx_rcnn_tpu.data.datasets.imdb import IMDB
+from mx_rcnn_tpu.evaluation.voc_eval import eval_class
+from mx_rcnn_tpu.logger import logger
+
+
+class PascalVOC(IMDB):
+    def __init__(self, image_set: str, root_path: str = "data",
+                 dataset_path: str = "data/VOCdevkit"):
+        year, sset = image_set.split("_", 1)
+        super().__init__(f"voc_{year}", sset, root_path, dataset_path)
+        self.year = year
+        self.classes = VOC_CLASSES
+        self._class_to_ind = {c: i for i, c in enumerate(self.classes)}
+        self.data_path = os.path.join(dataset_path, f"VOC{year}")
+        self.image_index = self._load_image_index()
+        self.num_images = len(self.image_index)
+
+    def _load_image_index(self) -> List[str]:
+        path = os.path.join(self.data_path, "ImageSets", "Main",
+                            f"{self.image_set}.txt")
+        with open(path) as f:
+            return [line.strip().split()[0] for line in f if line.strip()]
+
+    def image_path_from_index(self, index: str) -> str:
+        return os.path.join(self.data_path, "JPEGImages", f"{index}.jpg")
+
+    def _parse_annotation(self, index: str) -> Dict:
+        tree = ET.parse(
+            os.path.join(self.data_path, "Annotations", f"{index}.xml"))
+        size = tree.find("size")
+        width = int(size.find("width").text)
+        height = int(size.find("height").text)
+        boxes, classes, difficult = [], [], []
+        for obj in tree.findall("object"):
+            name = obj.find("name").text.lower().strip()
+            if name not in self._class_to_ind:
+                continue
+            diff = obj.find("difficult")
+            is_diff = int(diff.text) if diff is not None else 0
+            bb = obj.find("bndbox")
+            # VOC is 1-indexed; convert to 0-indexed inclusive.
+            x1 = float(bb.find("xmin").text) - 1
+            y1 = float(bb.find("ymin").text) - 1
+            x2 = float(bb.find("xmax").text) - 1
+            y2 = float(bb.find("ymax").text) - 1
+            boxes.append([x1, y1, x2, y2])
+            classes.append(self._class_to_ind[name])
+            difficult.append(is_diff)
+        boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+        classes = np.asarray(classes, np.int32)
+        difficult = np.asarray(difficult, bool)
+        # Training uses only non-difficult objects (reference behavior).
+        keep = ~difficult
+        return {
+            "image": self.image_path_from_index(index),
+            "height": height,
+            "width": width,
+            "boxes": boxes[keep],
+            "gt_classes": classes[keep],
+            "all_boxes": boxes,
+            "all_classes": classes,
+            "difficult": difficult,
+            "flipped": False,
+        }
+
+    def _load_gt_roidb(self) -> List[Dict]:
+        return [self._parse_annotation(idx) for idx in self.image_index]
+
+    # -- evaluation -------------------------------------------------------
+
+    def write_results(self, all_boxes, out_dir: str):
+        """comp4-style per-class result files (reference:
+        pascal_voc.py write_pascal_results)."""
+        os.makedirs(out_dir, exist_ok=True)
+        for c, cls in enumerate(self.classes):
+            if cls == "__background__":
+                continue
+            path = os.path.join(out_dir, f"comp4_det_{self.image_set}_{cls}.txt")
+            with open(path, "w") as f:
+                for i, index in enumerate(self.image_index):
+                    dets = all_boxes[c][i]
+                    if dets is None or len(dets) == 0:
+                        continue
+                    for d in dets:
+                        # back to 1-indexed VOC coords
+                        f.write(f"{index} {d[4]:.6f} {d[0]+1:.1f} "
+                                f"{d[1]+1:.1f} {d[2]+1:.1f} {d[3]+1:.1f}\n")
+
+    def evaluate_detections(self, all_boxes, use_07_metric: bool = None,
+                            iou_thresh: float = 0.5, **kwargs):
+        """Per-class VOC AP + mAP. 07 metric for year 2007 (reference
+        default)."""
+        if use_07_metric is None:
+            use_07_metric = self.year == "2007"
+        annos = {idx: self._parse_annotation(idx) for idx in self.image_index}
+        aps = {}
+        for c, cls in enumerate(self.classes):
+            if cls == "__background__":
+                continue
+            gt_by_image, diff_by_image, det_by_image = {}, {}, {}
+            for i, idx in enumerate(self.image_index):
+                a = annos[idx]
+                sel = a["all_classes"] == c
+                gt_by_image[idx] = a["all_boxes"][sel]
+                diff_by_image[idx] = a["difficult"][sel]
+                dets = all_boxes[c][i]
+                if dets is not None and len(dets):
+                    det_by_image[idx] = np.asarray(dets)
+            aps[cls] = eval_class(gt_by_image, det_by_image, diff_by_image,
+                                  iou_thresh, use_07_metric)
+        m = float(np.mean(list(aps.values())))
+        for cls, ap in aps.items():
+            logger.info("AP for %s = %.4f", cls, ap)
+        logger.info("Mean AP = %.4f", m)
+        return {"mAP": m, **aps}

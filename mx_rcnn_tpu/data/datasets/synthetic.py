@@ -1,0 +1,95 @@
+"""Synthetic detection dataset — offline CI stand-in.
+
+No reference analog (the reference assumes downloaded VOC/COCO; this
+environment is fully offline, SURVEY.md §8 'Environment facts'). Generates
+images with colored axis-aligned rectangles on textured noise; class = color.
+Deterministic per (split, index) so roidb caching and eval are stable.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List
+
+import numpy as np
+
+from mx_rcnn_tpu.data.datasets.imdb import IMDB
+
+_CLASS_COLORS = np.asarray(
+    [
+        (0, 0, 0),        # background, unused
+        (220, 40, 40),    # class 1: red
+        (40, 200, 60),    # class 2: green
+        (50, 80, 230),    # class 3: blue
+    ],
+    np.float32,
+)
+
+
+class SyntheticDataset(IMDB):
+    classes_tuple = ("__background__", "red_box", "green_box", "blue_box")
+
+    def __init__(self, image_set: str, root_path: str = "data",
+                 dataset_path: str = "", num_images: int = 32,
+                 image_size: int = 320, max_objects: int = 4, seed: int = 0):
+        super().__init__("synthetic", image_set, root_path, dataset_path)
+        self.classes = self.classes_tuple
+        self.num_images = num_images
+        self.image_size = image_size
+        self.max_objects = max_objects
+        # crc32, not hash(): str hashing is randomized per process and would
+        # break the deterministic-per-(split, index) contract.
+        self._seed = seed + (zlib.crc32(image_set.encode()) % 1000)
+
+    def gt_roidb(self) -> List[Dict]:  # no cache — cheap to regenerate
+        return self._load_gt_roidb()
+
+    def _gen(self, index: int):
+        rs = np.random.RandomState(self._seed * 100003 + index)
+        s = self.image_size
+        img = rs.uniform(80, 150, (s, s, 3)).astype(np.float32)
+        n = rs.randint(1, self.max_objects + 1)
+        boxes, classes = [], []
+        for _ in range(n):
+            w = rs.randint(s // 8, s // 2)
+            h = rs.randint(s // 8, s // 2)
+            x1 = rs.randint(0, s - w)
+            y1 = rs.randint(0, s - h)
+            cls = rs.randint(1, len(self.classes))
+            color = _CLASS_COLORS[cls] + rs.uniform(-15, 15, 3)
+            img[y1:y1 + h, x1:x1 + w] = color
+            boxes.append([x1, y1, x1 + w - 1, y1 + h - 1])
+            classes.append(cls)
+        return img, np.asarray(boxes, np.float32), np.asarray(classes, np.int32)
+
+    def _load_gt_roidb(self) -> List[Dict]:
+        roidb = []
+        for i in range(self.num_images):
+            img, boxes, classes = self._gen(i)
+            roidb.append({
+                "index": i,
+                "image_data": img,
+                "height": img.shape[0],
+                "width": img.shape[1],
+                "boxes": boxes,
+                "gt_classes": classes,
+                "flipped": False,
+            })
+        return roidb
+
+    def evaluate_detections(self, all_boxes, iou_thresh: float = 0.5,
+                            use_07_metric: bool = False, **kwargs):
+        """VOC-protocol mAP over the synthetic gt (reuses eval/voc_eval)."""
+        from mx_rcnn_tpu.evaluation.voc_eval import voc_ap_from_arrays
+
+        roidb = self._load_gt_roidb()
+        aps = {}
+        for c in range(1, self.num_classes):
+            gts = {
+                r["index"]: r["boxes"][r["gt_classes"] == c] for r in roidb
+            }
+            dets = all_boxes[c]
+            ap = voc_ap_from_arrays(gts, dets, iou_thresh, use_07_metric)
+            aps[self.classes[c]] = ap
+        m = float(np.mean(list(aps.values()))) if aps else 0.0
+        return {"mAP": m, **aps}

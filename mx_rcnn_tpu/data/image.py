@@ -1,0 +1,103 @@
+"""Host image IO and geometry.
+
+Reference: rcnn/io/image.py — cv2 BGR load, `resize` (target short side, max
+long side), `transform` (mean-subtract, HWC→CHW), `transform_inverse`,
+`tensor_vstack` pad-and-stack.
+
+TPU deltas: images stay HWC (NHWC is the TPU layout), RGB order, and every
+batch is padded to ONE static shape (config.image.pad_shape) instead of the
+reference's per-batch max-shape padding — that is what makes the whole train
+step a single compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+try:  # cv2 when present (fast JPEG decode), PIL fallback.
+    import cv2
+
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover
+    _HAS_CV2 = False
+
+try:
+    from PIL import Image
+
+    _HAS_PIL = True
+except Exception:  # pragma: no cover
+    _HAS_PIL = False
+
+
+def load_image(path: str) -> np.ndarray:
+    """Load an image file as RGB float32 HWC."""
+    if _HAS_CV2:
+        img = cv2.imread(path, cv2.IMREAD_COLOR)
+        if img is None:
+            raise FileNotFoundError(path)
+        return img[:, :, ::-1].astype(np.float32)  # BGR→RGB
+    if _HAS_PIL:
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB"), dtype=np.float32)
+    raise RuntimeError("neither cv2 nor PIL available")
+
+
+def resize_image(
+    img: np.ndarray, target_size: int, max_size: int
+) -> Tuple[np.ndarray, float]:
+    """Scale so the short side is target_size, capped so the long side
+    <= max_size (reference: rcnn/io/image.py::resize)."""
+    h, w = img.shape[:2]
+    short, long = min(h, w), max(h, w)
+    scale = float(target_size) / short
+    if round(scale * long) > max_size:
+        scale = float(max_size) / long
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    if _HAS_CV2:
+        out = cv2.resize(img, (nw, nh), interpolation=cv2.INTER_LINEAR)
+    else:
+        out = np.asarray(
+            Image.fromarray(img.astype(np.uint8)).resize((nw, nh), Image.BILINEAR),
+            dtype=np.float32,
+        )
+    return out.astype(np.float32), scale
+
+
+def transform_image(img: np.ndarray, pixel_means: Sequence[float],
+                    pixel_stds: Sequence[float] = (1.0, 1.0, 1.0)) -> np.ndarray:
+    """Mean-subtract (RGB). Stays HWC (reference transposes to CHW)."""
+    return (img - np.asarray(pixel_means, np.float32)) / np.asarray(
+        pixel_stds, np.float32)
+
+
+def transform_inverse(img: np.ndarray, pixel_means: Sequence[float],
+                      pixel_stds: Sequence[float] = (1.0, 1.0, 1.0)) -> np.ndarray:
+    """Undo transform_image for visualization (reference: transform_inverse)."""
+    out = img * np.asarray(pixel_stds, np.float32) + np.asarray(
+        pixel_means, np.float32)
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def pad_image(img: np.ndarray, pad_shape: Tuple[int, int]) -> np.ndarray:
+    """Zero-pad HWC image to the static (H, W) canvas (bottom/right)."""
+    ph, pw = pad_shape
+    h, w = img.shape[:2]
+    if h > ph or w > pw:
+        raise ValueError(f"image {h}x{w} exceeds pad shape {ph}x{pw}")
+    out = np.zeros((ph, pw, img.shape[2]), img.dtype)
+    out[:h, :w] = img
+    return out
+
+
+def flip_image_and_boxes(img: np.ndarray, boxes: np.ndarray):
+    """Horizontal flip of image + boxes (reference: append_flipped_images'
+    box mirror — x1' = W-1-x2, x2' = W-1-x1)."""
+    w = img.shape[1]
+    flipped = img[:, ::-1].copy()
+    out = boxes.copy()
+    if boxes.size:
+        out[:, 0] = w - 1 - boxes[:, 2]
+        out[:, 2] = w - 1 - boxes[:, 0]
+    return flipped, out

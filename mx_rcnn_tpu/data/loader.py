@@ -1,0 +1,293 @@
+"""Batch loaders with background prefetch.
+
+Reference: rcnn/core/loader.py — AnchorLoader (training DataIter: shuffle
+with aspect-ratio grouping, load+resize, host-side assign_anchor) and
+TestLoader (batch-1 inference iterator).
+
+TPU deltas:
+- anchor/ROI target assignment moved on-device (targets/), so AnchorLoader
+  only yields images + padded gt boxes;
+- every batch has ONE static shape (config.image.pad_shape + max_gt_boxes);
+- a worker-thread pool decodes/resizes ahead of the device (the reference
+  overlaps only via MXNet's PrefetchingIter when wired, SURVEY.md §4.1 'hot
+  loops');
+- aspect grouping survives as a perf knob (groups portrait/landscape so the
+  short-side resize wastes less canvas), not a correctness feature.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.data.image import (
+    flip_image_and_boxes,
+    load_image,
+    pad_image,
+    resize_image,
+    transform_image,
+)
+
+
+def _load_roidb_entry(entry: Dict, cfg: Config):
+    """roidb record → (padded image f32 HWC, im_info, boxes, classes) at the
+    training scale. Handles the `flipped` flag the imdb sets."""
+    if "image_data" in entry:  # synthetic datasets embed pixels directly
+        img = entry["image_data"].astype(np.float32)
+    else:
+        img = load_image(entry["image"])
+    boxes = entry["boxes"].astype(np.float32).copy()
+    if entry.get("flipped"):
+        img, boxes = flip_image_and_boxes(img, boxes)
+    target, max_size = cfg.image.scales[0]
+    img, scale = resize_image(img, target, max_size)
+    boxes *= scale
+    h, w = img.shape[:2]
+    img = transform_image(img, cfg.image.pixel_means, cfg.image.pixel_stds)
+    img = pad_image(img, cfg.image.pad_shape)
+    im_info = np.asarray([h, w, scale], np.float32)
+    return img, im_info, boxes, entry["gt_classes"].astype(np.int32)
+
+
+def _pad_gt(boxes: np.ndarray, classes: np.ndarray, max_gt: int):
+    g = min(len(boxes), max_gt)
+    out_b = np.zeros((max_gt, 4), np.float32)
+    out_c = np.zeros((max_gt,), np.int32)
+    out_v = np.zeros((max_gt,), bool)
+    out_b[:g] = boxes[:g]
+    out_c[:g] = classes[:g]
+    out_v[:g] = True
+    return out_b, out_c, out_v
+
+
+class _PrefetchIterator:
+    """Thread-pool prefetcher: indices → assembled batches, `depth` ahead.
+
+    Backpressure: workers acquire a slot semaphore (depth total) before
+    building a batch; the consumer releases it on yield — so at most `depth`
+    batches are buffered. Worker exceptions are captured and re-raised in the
+    consumer at that batch position (a dead loader must fail loudly, not
+    hang the train loop).
+    """
+
+    def __init__(self, make_batch, batch_indices: Sequence, depth: int = 4,
+                 workers: int = 4):
+        self._make = make_batch
+        self._indices = list(batch_indices)
+        self._slots = threading.Semaphore(max(1, depth))
+        self._threads: List[threading.Thread] = []
+        self._next = 0
+        self._lock = threading.Lock()
+        self._emitted = {}
+        self._emit_cond = threading.Condition()
+        self._stop = threading.Event()
+        for _ in range(max(1, workers)):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self):
+        while not self._stop.is_set():
+            if not self._slots.acquire(timeout=0.1):
+                continue  # re-check stop flag
+            with self._lock:
+                if self._next >= len(self._indices):
+                    self._slots.release()
+                    return
+                pos = self._next
+                self._next += 1
+            try:
+                result = ("ok", self._make(self._indices[pos]))
+            except BaseException as exc:  # noqa: BLE001 — re-raised in consumer
+                result = ("err", exc)
+            with self._emit_cond:
+                # Preserve order: the consumer pops positions sequentially.
+                self._emitted[pos] = result
+                self._emit_cond.notify_all()
+
+    def __iter__(self):
+        for pos in range(len(self._indices)):
+            with self._emit_cond:
+                while pos not in self._emitted and not self._stop.is_set():
+                    self._emit_cond.wait(timeout=0.1)
+                result = self._emitted.pop(pos, None)
+            if result is None:
+                return
+            kind, payload = result
+            self._slots.release()
+            if kind == "err":
+                self._stop.set()
+                raise payload
+            yield payload
+
+    def close(self):
+        self._stop.set()
+
+
+class AnchorLoader:
+    """Training loader: roidb → static-shape batches.
+
+    Yields dicts with keys image (B,H,W,3) f32, im_info (B,3),
+    gt_boxes (B,G,4), gt_classes (B,G), gt_valid (B,G) — the forward_train
+    batch contract. B = cfg.train.batch_images × num_shards (devices).
+    """
+
+    def __init__(self, roidb: List[Dict], cfg: Config, num_shards: int = 1,
+                 shuffle: Optional[bool] = None, seed: int = 0,
+                 prefetch_depth: int = 4, workers: int = 4):
+        self.roidb = roidb
+        self.cfg = cfg
+        self.batch_size = cfg.train.batch_images * num_shards
+        self.shuffle = cfg.train.shuffle if shuffle is None else shuffle
+        self.aspect_grouping = cfg.train.aspect_grouping
+        self._rng = np.random.RandomState(seed)
+        self._depth = prefetch_depth
+        self._workers = workers
+
+    def __len__(self):
+        return len(self.roidb) // self.batch_size
+
+    def _epoch_order(self) -> np.ndarray:
+        n = len(self.roidb)
+        if not self.shuffle:
+            return np.arange(n)
+        if self.aspect_grouping:
+            # Reference: group landscape vs portrait (loader.py) so resize
+            # shapes cluster; with one static pad it just improves locality.
+            widths = np.array([r.get("width", 1) for r in self.roidb])
+            heights = np.array([r.get("height", 1) for r in self.roidb])
+            horz = np.where(widths >= heights)[0]
+            vert = np.where(widths < heights)[0]
+            self._rng.shuffle(horz)
+            self._rng.shuffle(vert)
+            inds = np.hstack([horz, vert])
+            # Shuffle at batch granularity to keep groups together.
+            nb = n // self.batch_size
+            trimmed = inds[: nb * self.batch_size].reshape(nb, self.batch_size)
+            self._rng.shuffle(trimmed)
+            return trimmed.reshape(-1)
+        inds = np.arange(n)
+        self._rng.shuffle(inds)
+        return inds
+
+    def _make_batch(self, idxs: np.ndarray) -> Dict[str, np.ndarray]:
+        cfg = self.cfg
+        g = cfg.train.max_gt_boxes
+        imgs, infos, gtb, gtc, gtv = [], [], [], [], []
+        for i in idxs:
+            img, info, boxes, classes = _load_roidb_entry(self.roidb[i], cfg)
+            b, c, v = _pad_gt(boxes, classes, g)
+            imgs.append(img)
+            infos.append(info)
+            gtb.append(b)
+            gtc.append(c)
+            gtv.append(v)
+        return {
+            "image": np.stack(imgs),
+            "im_info": np.stack(infos),
+            "gt_boxes": np.stack(gtb),
+            "gt_classes": np.stack(gtc),
+            "gt_valid": np.stack(gtv),
+        }
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        order = self._epoch_order()
+        nb = len(order) // self.batch_size
+        batches = order[: nb * self.batch_size].reshape(nb, self.batch_size)
+        it = _PrefetchIterator(self._make_batch, batches,
+                               depth=self._depth, workers=self._workers)
+        try:
+            yield from it
+        finally:
+            it.close()
+
+
+class ROIIter(AnchorLoader):
+    """Fast-R-CNN-stage loader over precomputed proposals.
+
+    Reference: rcnn/core/loader.py::ROIIter (selective-search or RPN-dumped
+    proposals from imdb.rpn_roidb). Adds proposals (B, P, 4) +
+    proposal_valid (B, P) to the batch, padded to `max_proposals`.
+    """
+
+    def __init__(self, roidb: List[Dict], cfg: Config, num_shards: int = 1,
+                 max_proposals: int = 2000, **kw):
+        super().__init__(roidb, cfg, num_shards, **kw)
+        self.max_proposals = max_proposals
+
+    def _make_batch(self, idxs: np.ndarray) -> Dict[str, np.ndarray]:
+        batch = super()._make_batch(idxs)
+        p = self.max_proposals
+        props = np.zeros((len(idxs), p, 4), np.float32)
+        pvalid = np.zeros((len(idxs), p), bool)
+        for j, i in enumerate(idxs):
+            entry = self.roidb[i]
+            raw = entry.get("proposals",
+                            np.zeros((0, 4), np.float32)).astype(np.float32)
+            if entry.get("flipped") and len(raw):
+                w = entry["width"]
+                raw = raw.copy()
+                x1 = raw[:, 0].copy()
+                raw[:, 0] = w - 1 - raw[:, 2]
+                raw[:, 2] = w - 1 - x1
+            scale = batch["im_info"][j, 2]
+            n = min(len(raw), p)
+            props[j, :n] = raw[:n] * scale
+            pvalid[j, :n] = True
+        batch["proposals"] = props
+        batch["proposal_valid"] = pvalid
+        return batch
+
+
+class TestLoader:
+    """Inference loader (reference: rcnn/core/loader.py TestLoader).
+
+    Yields (batch_dict, meta) where meta carries the per-image scale and true
+    size for mapping detections back to original image coordinates.
+    """
+
+    def __init__(self, roidb: List[Dict], cfg: Config, batch_size: int = 1,
+                 prefetch_depth: int = 4, workers: int = 2):
+        self.roidb = roidb
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self._depth = prefetch_depth
+        self._workers = workers
+
+    def __len__(self):
+        return (len(self.roidb) + self.batch_size - 1) // self.batch_size
+
+    def _make_batch(self, idxs):
+        cfg = self.cfg
+        imgs, infos, metas = [], [], []
+        for i in idxs:
+            if i < 0:  # tail padding repeats the last real image
+                i = len(self.roidb) - 1
+                real = False
+            else:
+                real = True
+            entry = self.roidb[i]
+            img, info, _, _ = _load_roidb_entry(
+                {**entry, "boxes": np.zeros((0, 4), np.float32),
+                 "gt_classes": np.zeros((0,), np.int32)}, cfg)
+            imgs.append(img)
+            infos.append(info)
+            metas.append({"index": i, "scale": float(info[2]), "real": real})
+        return {"image": np.stack(imgs), "im_info": np.stack(infos)}, metas
+
+    def __iter__(self):
+        n = len(self.roidb)
+        idxs = np.arange(n)
+        pad = (-n) % self.batch_size
+        if pad:
+            idxs = np.concatenate([idxs, -np.ones(pad, np.int64)])
+        batches = idxs.reshape(-1, self.batch_size)
+        it = _PrefetchIterator(self._make_batch, batches,
+                               depth=self._depth, workers=self._workers)
+        try:
+            yield from it
+        finally:
+            it.close()

@@ -1,0 +1,11 @@
+"""Evaluation — inference loop + dataset metrics.
+
+Reference layer L9 (rcnn/core/tester.py) plus the eval halves of
+rcnn/dataset/pascal_voc_eval.py and the vendored rcnn/pycocotools. COCO eval
+is reimplemented in-repo because pycocotools is not installed in this
+environment (SURVEY.md §8).
+"""
+
+from mx_rcnn_tpu.evaluation.tester import Predictor, im_detect, pred_eval
+
+__all__ = ["Predictor", "im_detect", "pred_eval"]

@@ -1,0 +1,223 @@
+"""COCO detection evaluation — in-repo reimplementation of the bbox protocol.
+
+Reference: the vendored rcnn/pycocotools/cocoeval.py (COCOeval) driven by
+rcnn/dataset/coco.py. pycocotools is NOT installed in this environment
+(SURVEY.md §8), so the matching + accumulation protocol is reimplemented
+from its published definition:
+
+- 10 IoU thresholds 0.50:0.05:0.95, 101 recall points, 4 area ranges,
+  maxDets (1, 10, 100);
+- COCO boxes are (x, y, w, h) with EXCLUSIVE widths (no +1);
+- crowd ground truths are ignore regions: IoU against a crowd is
+  intersection / det area, and a crowd match marks the detection ignored
+  rather than true-positive;
+- greedy matching in score order; each non-ignore gt matches at most once;
+  an already-found non-ignore match is never displaced by an ignore one.
+
+Validated against hand-checked small cases in tests/test_coco_eval.py.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from mx_rcnn_tpu.logger import logger
+
+IOU_THRS = np.linspace(0.5, 0.95, 10)
+REC_THRS = np.linspace(0.0, 1.0, 101)
+AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0 ** 2),
+    "medium": (32.0 ** 2, 96.0 ** 2),
+    "large": (96.0 ** 2, 1e10),
+}
+MAX_DETS = (1, 10, 100)
+
+
+def bbox_iou_xywh(dets: np.ndarray, gts: np.ndarray,
+                  iscrowd: np.ndarray) -> np.ndarray:
+    """(D,4) x (G,4) xywh IoU, exclusive widths; crowd gt → inter/det_area."""
+    d = dets[:, None]
+    g = gts[None, :]
+    ix = np.minimum(d[..., 0] + d[..., 2], g[..., 0] + g[..., 2]) - np.maximum(
+        d[..., 0], g[..., 0])
+    iy = np.minimum(d[..., 1] + d[..., 3], g[..., 1] + g[..., 3]) - np.maximum(
+        d[..., 1], g[..., 1])
+    inter = np.maximum(ix, 0) * np.maximum(iy, 0)
+    area_d = d[..., 2] * d[..., 3]
+    area_g = g[..., 2] * g[..., 3]
+    union = np.where(iscrowd[None, :], area_d, area_d + area_g - inter)
+    return inter / np.maximum(union, 1e-10)
+
+
+class COCOEval:
+    """Bbox evaluation of a results list against an instances-json dict."""
+
+    def __init__(self, dataset: Dict, results: Sequence[Dict],
+                 max_dets: Sequence[int] = MAX_DETS):
+        self.max_dets = tuple(max_dets)
+        self.img_ids = sorted(im["id"] for im in dataset["images"])
+        self.cat_ids = sorted(c["id"] for c in dataset["categories"])
+        self._gts = defaultdict(list)
+        for ann in dataset["annotations"]:
+            self._gts[(ann["image_id"], ann["category_id"])].append(ann)
+        self._dts = defaultdict(list)
+        for r in results:
+            self._dts[(r["image_id"], r["category_id"])].append(r)
+        self.stats: Dict[str, float] = {}
+
+    # -- per image/category matching --------------------------------------
+
+    def _evaluate_img(self, gts, gt_areas, iscrowd, dts, ious, area_rng):
+        """Greedy matching for one (image, category, area-range) cell.
+
+        gts/dts are already sorted (dets by score desc, capped at
+        max(max_dets)); ious computed once by the caller — only the ignore
+        flags depend on the area range, so matching runs 4×, not 12×
+        (the pycocotools structure: computeIoU once, evaluateImg per area,
+        maxDet sliced at accumulate time).
+        """
+        gt_ignore_area = np.array([
+            bool(g.get("iscrowd", 0))
+            or not (area_rng[0] <= a < area_rng[1])
+            for g, a in zip(gts, gt_areas)
+        ], bool)
+        # non-ignore gts first (stable) — matching prefers them.
+        g_order = np.argsort(gt_ignore_area, kind="stable")
+        gt_ignore = gt_ignore_area[g_order]
+        iscrowd = iscrowd[g_order]
+        ious = ious[:, g_order] if ious.size else ious
+
+        d_boxes = np.array([d["bbox"] for d in dts], np.float64).reshape(-1, 4)
+        T, D, G = len(IOU_THRS), len(dts), len(gts)
+        dt_match = np.zeros((T, D), bool)
+        dt_ignore = np.zeros((T, D), bool)
+        gt_match = np.zeros((T, G), bool)
+        for t, thr in enumerate(IOU_THRS):
+            for di in range(D):
+                best_iou = min(thr, 1 - 1e-10)
+                m = -1
+                for gi in range(G):
+                    if gt_match[t, gi] and not iscrowd[gi]:
+                        continue
+                    if m > -1 and not gt_ignore[m] and gt_ignore[gi]:
+                        break  # ignores are sorted last; keep the real match
+                    if ious[di, gi] < best_iou:
+                        continue
+                    best_iou = ious[di, gi]
+                    m = gi
+                if m == -1:
+                    continue
+                dt_match[t, di] = True
+                dt_ignore[t, di] = gt_ignore[m]
+                gt_match[t, m] = True
+        # Detections outside the area range and unmatched → ignored.
+        d_areas = d_boxes[:, 2] * d_boxes[:, 3]
+        d_out = (d_areas < area_rng[0]) | (d_areas >= area_rng[1])
+        dt_ignore |= (~dt_match) & d_out[None, :]
+        return {
+            "scores": np.array([d["score"] for d in dts]),
+            "dt_match": dt_match,
+            "dt_ignore": dt_ignore,
+            "num_gt": int((~gt_ignore).sum()),
+        }
+
+    # -- accumulation ------------------------------------------------------
+
+    def _evaluate_category(self, cat_id: int):
+        """Per-area matching results for one category, IoUs computed once."""
+        cap = max(self.max_dets)
+        per_area = {name: [] for name in AREA_RANGES}
+        for img_id in self.img_ids:
+            gts = self._gts.get((img_id, cat_id), [])
+            dts = self._dts.get((img_id, cat_id), [])
+            if not gts and not dts:
+                continue
+            d_order = np.argsort([-d["score"] for d in dts],
+                                 kind="stable")[:cap]
+            dts = [dts[i] for i in d_order]
+            iscrowd = np.array([bool(g.get("iscrowd", 0)) for g in gts], bool)
+            gt_areas = [g.get("area", g["bbox"][2] * g["bbox"][3]) for g in gts]
+            g_boxes = np.array([g["bbox"] for g in gts],
+                               np.float64).reshape(-1, 4)
+            d_boxes = np.array([d["bbox"] for d in dts],
+                               np.float64).reshape(-1, 4)
+            ious = (bbox_iou_xywh(d_boxes, g_boxes, iscrowd)
+                    if len(gts) and len(dts)
+                    else np.zeros((len(dts), len(gts))))
+            for name, rng in AREA_RANGES.items():
+                per_area[name].append(
+                    self._evaluate_img(gts, gt_areas, iscrowd, dts, ious, rng))
+        return per_area
+
+    def _accumulate_cell(self, evals, max_det: int) -> np.ndarray:
+        """precision (T, R) for one (category, area, maxDet) cell; −1 where
+        no gt exists. Per-image det lists are score-sorted, so the maxDet cap
+        is a per-image slice (pycocotools accumulate semantics)."""
+        T, R = len(IOU_THRS), len(REC_THRS)
+        precision = -np.ones((T, R))
+        if not evals:
+            return precision
+        npos = sum(e["num_gt"] for e in evals)
+        if npos == 0:
+            return precision
+        scores = np.concatenate([e["scores"][:max_det] for e in evals])
+        order = np.argsort(-scores, kind="mergesort")
+        dt_match = np.concatenate(
+            [e["dt_match"][:, :max_det] for e in evals], axis=1)[:, order]
+        dt_ignore = np.concatenate(
+            [e["dt_ignore"][:, :max_det] for e in evals], axis=1)[:, order]
+        tps = dt_match & ~dt_ignore
+        fps = ~dt_match & ~dt_ignore
+        tp_cum = np.cumsum(tps, axis=1).astype(np.float64)
+        fp_cum = np.cumsum(fps, axis=1).astype(np.float64)
+        for t in range(T):
+            tp, fp = tp_cum[t], fp_cum[t]
+            rec = tp / npos
+            prec = tp / np.maximum(tp + fp, 1e-10)
+            # precision envelope (monotone non-increasing from the right)
+            for i in range(len(prec) - 1, 0, -1):
+                prec[i - 1] = max(prec[i - 1], prec[i])
+            idx = np.searchsorted(rec, REC_THRS, side="left")
+            for r, pi in enumerate(idx):
+                precision[t, r] = prec[pi] if pi < len(prec) else 0.0
+        return precision
+
+    def accumulate(self):
+        self._precision = {}  # (area, maxDet) -> (T, R, K)
+        per_cat = {cat: self._evaluate_category(cat) for cat in self.cat_ids}
+        for area_name in AREA_RANGES:
+            for max_det in self.max_dets:
+                cells = [
+                    self._accumulate_cell(per_cat[cat][area_name], max_det)
+                    for cat in self.cat_ids
+                ]
+                self._precision[(area_name, max_det)] = np.stack(cells, axis=-1)
+        return self
+
+    def _ap(self, area: str = "all", max_det: int = 100, iou_thr=None) -> float:
+        p = self._precision[(area, max_det)]
+        if iou_thr is not None:
+            t = int(np.argmin(np.abs(IOU_THRS - iou_thr)))
+            p = p[t:t + 1]
+        valid = p[p > -1]
+        return float(valid.mean()) if valid.size else -1.0
+
+    def summarize(self) -> Dict[str, float]:
+        if not hasattr(self, "_precision"):
+            self.accumulate()
+        self.stats = {
+            "AP": self._ap(),
+            "AP50": self._ap(iou_thr=0.5),
+            "AP75": self._ap(iou_thr=0.75),
+            "APs": self._ap(area="small"),
+            "APm": self._ap(area="medium"),
+            "APl": self._ap(area="large"),
+        }
+        for k, v in self.stats.items():
+            logger.info("COCO %s = %.4f", k, v)
+        self.stats["mAP"] = self.stats["AP"]
+        return self.stats

@@ -1,0 +1,118 @@
+"""PASCAL VOC AP evaluation.
+
+Reference: rcnn/dataset/pascal_voc_eval.py::voc_eval — per-class ranked
+matching at IoU 0.5, greedy per-gt assignment, AP via either the VOC-07
+11-point metric or the continuous (area-under-PR) metric. Reimplemented from
+the protocol definition; operates either on result files (voc_eval) or
+directly on arrays (voc_ap_from_arrays — used by the synthetic dataset and
+unit tests).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def voc_ap(rec: np.ndarray, prec: np.ndarray, use_07_metric: bool = False) -> float:
+    """AP from a PR curve (reference: pascal_voc_eval.py::voc_ap)."""
+    if use_07_metric:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = np.max(prec[rec >= t]) if np.any(rec >= t) else 0.0
+            ap += p / 11.0
+        return float(ap)
+    mrec = np.concatenate([[0.0], rec, [1.0]])
+    mpre = np.concatenate([[0.0], prec, [0.0]])
+    for i in range(mpre.size - 1, 0, -1):
+        mpre[i - 1] = max(mpre[i - 1], mpre[i])
+    idx = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+
+def _iou_matrix(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """(D,4) x (G,4) -> (D,G) IoU with the VOC inclusive-pixel convention."""
+    ixmin = np.maximum(det[:, None, 0], gt[None, :, 0])
+    iymin = np.maximum(det[:, None, 1], gt[None, :, 1])
+    ixmax = np.minimum(det[:, None, 2], gt[None, :, 2])
+    iymax = np.minimum(det[:, None, 3], gt[None, :, 3])
+    iw = np.maximum(ixmax - ixmin + 1.0, 0.0)
+    ih = np.maximum(iymax - iymin + 1.0, 0.0)
+    inter = iw * ih
+    a_det = (det[:, 2] - det[:, 0] + 1.0) * (det[:, 3] - det[:, 1] + 1.0)
+    a_gt = (gt[:, 2] - gt[:, 0] + 1.0) * (gt[:, 3] - gt[:, 1] + 1.0)
+    union = a_det[:, None] + a_gt[None, :] - inter
+    return inter / np.maximum(union, 1e-10)
+
+
+def eval_class(
+    gt_by_image: Dict,
+    det_by_image: Dict,
+    difficult_by_image: Dict = None,
+    iou_thresh: float = 0.5,
+    use_07_metric: bool = False,
+) -> float:
+    """AP for one class.
+
+    gt_by_image: image_id -> (G, 4) gt boxes.
+    det_by_image: image_id -> (D, 5) [x1,y1,x2,y2,score].
+    difficult_by_image: image_id -> (G,) bool (VOC 'difficult' flags —
+      excluded from the positive pool and never counted as FP).
+    """
+    difficult_by_image = difficult_by_image or {}
+    npos = 0
+    matched = {}
+    for img, gt in gt_by_image.items():
+        diff = difficult_by_image.get(img)
+        if diff is None:
+            diff = np.zeros(len(gt), bool)
+        matched[img] = np.zeros(len(gt), bool)
+        npos += int((~diff).sum())
+
+    # Flatten detections, rank by score (reference sorts globally).
+    rows = []
+    for img, det in det_by_image.items():
+        for d in np.asarray(det).reshape(-1, 5):
+            rows.append((img, d))
+    if not rows or npos == 0:
+        return 0.0
+    rows.sort(key=lambda r: -r[1][4])
+
+    tp = np.zeros(len(rows))
+    fp = np.zeros(len(rows))
+    for i, (img, d) in enumerate(rows):
+        gt = gt_by_image.get(img)
+        if gt is None or len(gt) == 0:
+            fp[i] = 1
+            continue
+        ious = _iou_matrix(d[None, :4], gt)[0]
+        j = int(np.argmax(ious))
+        diff = difficult_by_image.get(img)
+        if ious[j] >= iou_thresh:
+            if diff is not None and diff[j]:
+                continue  # difficult gt: detection ignored entirely
+            if not matched[img][j]:
+                matched[img][j] = True
+                tp[i] = 1
+            else:
+                fp[i] = 1  # duplicate detection of a matched gt
+        else:
+            fp[i] = 1
+
+    ctp = np.cumsum(tp)
+    cfp = np.cumsum(fp)
+    rec = ctp / float(npos)
+    prec = ctp / np.maximum(ctp + cfp, np.finfo(np.float64).eps)
+    return voc_ap(rec, prec, use_07_metric)
+
+
+def voc_ap_from_arrays(gt_by_image: Dict, dets: List[np.ndarray],
+                       iou_thresh: float = 0.5,
+                       use_07_metric: bool = False) -> float:
+    """AP where dets is indexed by position: dets[i] = (D,5) for image id i
+    (the all_boxes[class] layout of pred_eval)."""
+    det_by_image = {
+        i: d for i, d in enumerate(dets) if d is not None and len(d)
+    }
+    return eval_class(gt_by_image, det_by_image, None, iou_thresh, use_07_metric)

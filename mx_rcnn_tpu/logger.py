@@ -1,0 +1,8 @@
+"""Module-level logger (reference: rcnn/logger.py)."""
+
+import logging
+
+logging.basicConfig(
+    format="%(asctime)s %(levelname)s %(message)s", level=logging.INFO
+)
+logger = logging.getLogger("mx_rcnn_tpu")

@@ -1,0 +1,28 @@
+"""Model graphs — flax modules replacing the reference's symbolic networks.
+
+Reference layer: rcnn/symbol/symbol_vgg.py and rcnn/symbol/symbol_resnet.py
+(get_*_train / get_*_test symbol builders). Here the graph is a flax module
+tree + pure functions (`forward_train`, `forward_test`) instead of a static
+symbol graph; train/test variants share parameters by construction.
+"""
+
+from mx_rcnn_tpu.models.backbones import ResNetC4, ResNetHead, VGGConv, VGGHead
+from mx_rcnn_tpu.models.rpn import RPNHead
+from mx_rcnn_tpu.models.faster_rcnn import (
+    FasterRCNN,
+    build_model,
+    forward_test,
+    forward_train,
+)
+
+__all__ = [
+    "ResNetC4",
+    "ResNetHead",
+    "VGGConv",
+    "VGGHead",
+    "RPNHead",
+    "FasterRCNN",
+    "build_model",
+    "forward_train",
+    "forward_test",
+]

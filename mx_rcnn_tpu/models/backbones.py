@@ -1,0 +1,243 @@
+"""Backbones: ResNet-50/101 C4 and VGG-16, TPU-native.
+
+Replaces the reference's symbolic graph builders (rcnn/symbol/symbol_resnet.py
+``residual_unit``/``get_resnet_conv`` and rcnn/symbol/symbol_vgg.py
+``get_vgg_conv``) with flax modules. Deliberate deltas from the reference,
+chosen for TPU:
+
+- NHWC layout (MXU-native) instead of the reference's NCHW (cuDNN-native).
+- bfloat16 compute / float32 params via flax ``dtype``/``param_dtype``.
+- Frozen BatchNorm is an affine constant (reference: BN with
+  ``use_global_stats=True`` and fixed gamma/beta) — params carry
+  ``stop_gradient`` in the forward so the backward pass is structurally free,
+  and the trainer additionally masks them out of the optimizer.
+- The frozen prefix (reference ``fixed_param_prefix``: ResNet conv0+stage1,
+  VGG conv1-conv2) is a ``stop_gradient`` cut on the activation at the freeze
+  boundary, so XLA never materializes the early backward graph at all —
+  cheaper than the reference's approach of computing and discarding nothing
+  (MXNet skips those grads too via fixed_param_names; we keep parity).
+- ResNet block is the post-activation v1.5 bottleneck (stride on the 3x3).
+  The reference uses the tornadomeet v2 pre-act variant; since pretrained
+  MXNet checkpoints cannot be loaded in this environment the standard
+  detection (Detectron-lineage) block is used and documented here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+STAGE_BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+class FrozenBatchNorm(nn.Module):
+    """BN with frozen statistics AND frozen affine (reference semantics).
+
+    Reference: rcnn/symbol/symbol_resnet.py BatchNorm(use_global_stats=True,
+    fixed gamma/beta via fixed_param_prefix). At train and test time this is
+    y = gamma * (x - mean) * rsqrt(var + eps) + beta with every tensor
+    constant, which XLA folds into the preceding conv.
+    """
+
+    features: int
+    eps: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        f = self.features
+        gamma = self.param("gamma", nn.initializers.ones, (f,), jnp.float32)
+        beta = self.param("beta", nn.initializers.zeros, (f,), jnp.float32)
+        mean = self.param("moving_mean", nn.initializers.zeros, (f,), jnp.float32)
+        var = self.param("moving_var", nn.initializers.ones, (f,), jnp.float32)
+        # Fold to a single scale/bias pair; stop_gradient makes freezing
+        # structural (no backward graph through BN params).
+        scale = jax.lax.stop_gradient(gamma * jax.lax.rsqrt(var + self.eps))
+        bias = jax.lax.stop_gradient(beta - mean * scale)
+        return x * scale.astype(self.dtype) + bias.astype(self.dtype)
+
+
+class Bottleneck(nn.Module):
+    """ResNet v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1, post-activation."""
+
+    filters: int  # inner width; output is 4*filters
+    stride: int = 1
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        needs_proj = x.shape[-1] != self.filters * 4 or self.stride != 1
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv1")(x)
+        y = FrozenBatchNorm(self.filters, dtype=self.dtype, name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), strides=(self.stride, self.stride),
+                    padding=[(1, 1), (1, 1)], use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv2")(y)
+        y = FrozenBatchNorm(self.filters, dtype=self.dtype, name="bn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv3")(y)
+        y = FrozenBatchNorm(self.filters * 4, dtype=self.dtype, name="bn3")(y)
+        if needs_proj:
+            residual = nn.Conv(self.filters * 4, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype,
+                               param_dtype=jnp.float32, name="downsample_conv")(x)
+            residual = FrozenBatchNorm(self.filters * 4, dtype=self.dtype,
+                                       name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetStage(nn.Module):
+    blocks: int
+    filters: int
+    stride: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i in range(self.blocks):
+            x = Bottleneck(self.filters, stride=self.stride if i == 0 else 1,
+                           dtype=self.dtype, name=f"block{i}")(x)
+        return x
+
+
+class ResNetC4(nn.Module):
+    """ResNet conv0 + stages 1-3 -> stride-16, 1024-channel C4 features.
+
+    Reference: rcnn/symbol/symbol_resnet.py get_resnet_conv (units for 50/101
+    layers, ends at the stage-4-in-torch-numbering res4 block). ``freeze_at=2``
+    reproduces fixed_param_prefix=['conv0','stage1'] via an activation
+    stop_gradient cut.
+    """
+
+    depth: int = 50
+    freeze_at: int = 2  # 0=no freeze, 1=stem, 2=stem+stage1 (reference default)
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        blocks = STAGE_BLOCKS[self.depth]
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+                    name="conv0")(x)
+        x = FrozenBatchNorm(64, dtype=self.dtype, name="bn0")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        if self.freeze_at >= 1:
+            x = jax.lax.stop_gradient(x)
+        x = ResNetStage(blocks[0], 64, stride=1, dtype=self.dtype, name="stage1")(x)
+        if self.freeze_at >= 2:
+            x = jax.lax.stop_gradient(x)
+        x = ResNetStage(blocks[1], 128, stride=2, dtype=self.dtype, name="stage2")(x)
+        x = ResNetStage(blocks[2], 256, stride=2, dtype=self.dtype, name="stage3")(x)
+        return x  # (B, H/16, W/16, 1024)
+
+
+class ResNetStages(nn.Module):
+    """All four stages with per-stage outputs — the FPN backbone variant.
+
+    Returns (C2, C3, C4, C5) at strides (4, 8, 16, 32).
+    """
+
+    depth: int = 50
+    freeze_at: int = 2
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Sequence[jnp.ndarray]:
+        blocks = STAGE_BLOCKS[self.depth]
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+                    name="conv0")(x)
+        x = FrozenBatchNorm(64, dtype=self.dtype, name="bn0")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        if self.freeze_at >= 1:
+            x = jax.lax.stop_gradient(x)
+        c2 = ResNetStage(blocks[0], 64, stride=1, dtype=self.dtype, name="stage1")(x)
+        if self.freeze_at >= 2:
+            c2 = jax.lax.stop_gradient(c2)
+        c3 = ResNetStage(blocks[1], 128, stride=2, dtype=self.dtype, name="stage2")(c2)
+        c4 = ResNetStage(blocks[2], 256, stride=2, dtype=self.dtype, name="stage3")(c3)
+        c5 = ResNetStage(blocks[3], 512, stride=2, dtype=self.dtype, name="stage4")(c4)
+        return c2, c3, c4, c5
+
+
+class ResNetHead(nn.Module):
+    """C4 detection head: stage 5 on pooled 14x14 ROIs -> global avg pool.
+
+    Reference: rcnn/symbol/symbol_resnet.py — ROIPooling 14x14 then the
+    stage-5 residual blocks (stride 2 -> 7x7) then global average pooling,
+    feeding cls_score/bbox_pred FCs.
+    Input (R, 14, 14, 1024) -> output (R, 2048).
+    """
+
+    depth: int = 50
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, rois_feat: jnp.ndarray) -> jnp.ndarray:
+        blocks = STAGE_BLOCKS[self.depth]
+        x = ResNetStage(blocks[3], 512, stride=2, dtype=self.dtype,
+                        name="stage4")(rois_feat.astype(self.dtype))
+        return jnp.mean(x, axis=(1, 2))  # (R, 2048)
+
+
+class VGGConv(nn.Module):
+    """VGG-16 conv1_1..conv5_3, stride-16 512-channel features.
+
+    Reference: rcnn/symbol/symbol_vgg.py get_vgg_conv (13 convs, 4 pools —
+    pool5 omitted so the feature stride stays 16; conv1-conv2 frozen via
+    fixed_param_prefix, here a stop_gradient cut after block 2).
+    """
+
+    freeze_blocks: int = 2
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        plan = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+        x = x.astype(self.dtype)
+        for b, (n_convs, width) in enumerate(plan, start=1):
+            for c in range(1, n_convs + 1):
+                x = nn.Conv(width, (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype, param_dtype=jnp.float32,
+                            name=f"conv{b}_{c}")(x)
+                x = nn.relu(x)
+            if b < 5:  # no pool5 — keep stride 16
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            if b == self.freeze_blocks:
+                x = jax.lax.stop_gradient(x)
+        return x  # (B, H/16, W/16, 512)
+
+
+class VGGHead(nn.Module):
+    """fc6/fc7 head on 7x7 pooled ROIs (reference: symbol_vgg.py fc6, fc7).
+
+    Input (R, 7, 7, 512) -> (R, 4096). Dropout as in the reference (0.5),
+    active only when ``deterministic=False``.
+    """
+
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, rois_feat: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        r = rois_feat.shape[0]
+        x = rois_feat.astype(self.dtype).reshape(r, -1)
+        x = nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32, name="fc6")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=deterministic)(x)
+        x = nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32, name="fc7")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=deterministic)(x)
+        return x

@@ -1,0 +1,465 @@
+"""Faster R-CNN — model module + pure train/test forwards.
+
+Replaces the reference's train/test symbol builders
+(rcnn/symbol/symbol_vgg.py::get_vgg_train/get_vgg_test,
+rcnn/symbol/symbol_resnet.py::get_resnet_train/get_resnet_test) and the
+graph-embedded Proposal/ProposalTarget custom ops
+(rcnn/symbol/proposal.py, rcnn/symbol/proposal_target.py).
+
+The single biggest design delta vs the reference (SURVEY.md §8): the whole
+step — backbone, RPN, proposal generation, anchor/ROI target assignment, ROI
+pooling, heads, losses — is ONE traced XLA program. The reference bounces to
+the host for ProposalTarget (numpy sampling) every step; here everything is
+static-shape and stays on device.
+
+Data layout: NHWC images, (B, N, ·) flattened anchor grids where
+N = H/16 · W/16 · A, matching ops/anchors.anchor_grid ordering.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models.backbones import ResNetC4, ResNetHead, VGGConv, VGGHead
+from mx_rcnn_tpu.models.losses import rcnn_losses, rpn_losses
+from mx_rcnn_tpu.models.rpn import RPNHead
+from mx_rcnn_tpu.ops.anchors import anchor_grid
+from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
+from mx_rcnn_tpu.ops.proposal import generate_proposals
+from mx_rcnn_tpu.ops.roi_align import roi_align, roi_pool
+from mx_rcnn_tpu.targets.rcnn_targets import sample_rois
+from mx_rcnn_tpu.targets.rpn_targets import assign_anchor
+
+
+class FasterRCNN(nn.Module):
+    """Backbone + RPN + box head as one parameter tree.
+
+    Methods are exposed individually (via ``apply(..., method=...)``) so the
+    train and test forwards can wire the non-parametric middle (proposals,
+    target sampling, ROI pooling) differently while sharing parameters —
+    the analog of the reference's get_*_train/get_*_test sharing arg_params.
+    """
+
+    backbone: str = "resnet50"  # "resnet50" | "resnet101" | "vgg"
+    num_classes: int = 81
+    num_anchors: int = 9
+    roi_pool_size: int = 14
+    roi_pool_type: str = "align"
+    dtype: Any = jnp.bfloat16
+
+    def setup(self):
+        if self.backbone.startswith("resnet"):
+            depth = int(self.backbone.replace("resnet", ""))
+            self.features = ResNetC4(depth=depth, dtype=self.dtype)
+            self.head = ResNetHead(depth=depth, dtype=self.dtype)
+        elif self.backbone == "vgg":
+            self.features = VGGConv(dtype=self.dtype)
+            self.head = VGGHead(dtype=self.dtype)
+        else:
+            raise ValueError(f"unknown backbone {self.backbone!r}")
+        self.rpn = RPNHead(num_anchors=self.num_anchors, dtype=self.dtype)
+        self.cls_score = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.01), name="cls_score")
+        self.bbox_pred = nn.Dense(
+            self.num_classes * 4, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.001), name="bbox_pred")
+
+    def extract(self, images: jnp.ndarray) -> jnp.ndarray:
+        return self.features(images)
+
+    def rpn_forward(self, feat: jnp.ndarray):
+        return self.rpn(feat)
+
+    def box_head(self, pooled: jnp.ndarray, deterministic: bool = True):
+        if self.backbone == "vgg":
+            x = self.head(pooled, deterministic=deterministic)
+        else:
+            x = self.head(pooled)
+        cls = self.cls_score(x).astype(jnp.float32)
+        box = self.bbox_pred(x).astype(jnp.float32)
+        return cls, box
+
+    def __call__(self, images: jnp.ndarray, rois: jnp.ndarray):
+        """Init-only path touching every submodule."""
+        feat = self.extract(images)
+        rpn_cls, rpn_box = self.rpn_forward(feat)
+        pooled = roi_align(feat, rois, self.roi_pool_size, 1.0 / 16.0)
+        cls, box = self.box_head(pooled)
+        return feat, rpn_cls, rpn_box, cls, box
+
+
+# ---------------------------------------------------------------------------
+# Functional forwards
+# ---------------------------------------------------------------------------
+
+
+def _rpn_softmax(cls_logits: jnp.ndarray, num_anchors: int) -> jnp.ndarray:
+    """(B,H,W,2A) logits, [bg×A, fg×A] layout → softmaxed probs same layout.
+
+    Reference: rpn_cls_score reshape to (2, A·H·W) + SoftmaxOutput over the
+    2-way axis (symbol_*.py rpn_cls_prob).
+    """
+    a = num_anchors
+    bg, fg = cls_logits[..., :a], cls_logits[..., a:]
+    m = jnp.maximum(bg, fg)
+    ebg = jnp.exp(bg - m)
+    efg = jnp.exp(fg - m)
+    denom = ebg + efg
+    return jnp.concatenate([ebg / denom, efg / denom], axis=-1)
+
+
+def _pair_logits(cls_logits: jnp.ndarray, num_anchors: int) -> jnp.ndarray:
+    """(B,H,W,2A) → (B, H·W·A, 2) per-anchor [bg, fg] logits."""
+    b, h, w, _ = cls_logits.shape
+    a = num_anchors
+    bg = cls_logits[..., :a].reshape(b, -1)
+    fg = cls_logits[..., a:].reshape(b, -1)
+    return jnp.stack([bg, fg], axis=-1)
+
+
+def _pool_rois(feat, rois, roi_valid, pool_size, pool_type):
+    """Batched ROI pooling: (B,Hf,Wf,C) + (B,R,4) → (B·R,P,P,C).
+
+    Builds the (batch_idx, x1..y2) 5-vector layout the pooling ops share with
+    the reference's ROIPooling input convention.
+    """
+    b, r = rois.shape[0], rois.shape[1]
+    batch_idx = jnp.repeat(jnp.arange(b, dtype=jnp.float32), r)[:, None]
+    flat = jnp.concatenate([batch_idx, rois.reshape(b * r, 4)], axis=1)
+    if pool_type == "align":
+        pooled = roi_align(feat, flat, pool_size, 1.0 / 16.0)
+    else:
+        pooled = roi_pool(feat, flat, pool_size, 1.0 / 16.0)
+    # Zero padded slots so dead rois contribute nothing downstream.
+    return pooled * roi_valid.reshape(b * r, 1, 1, 1).astype(pooled.dtype)
+
+
+def _backbone_rpn(model: FasterRCNN, params, images: jnp.ndarray, cfg: Config):
+    """Shared preamble: backbone features + RPN outputs + the anchor grid
+    (compile-time const). Used by every forward variant."""
+    feat = model.apply(params, images, method=FasterRCNN.extract)
+    rpn_cls_logits, rpn_bbox_deltas = model.apply(
+        params, feat, method=FasterRCNN.rpn_forward)
+    anchors = jnp.asarray(anchor_grid(
+        feat.shape[1], feat.shape[2],
+        stride=cfg.network.rpn_feat_stride,
+        base_size=cfg.network.anchor_base_size,
+        ratios=cfg.network.anchor_ratios,
+        scales=cfg.network.anchor_scales,
+    ))
+    return feat, rpn_cls_logits, rpn_bbox_deltas, anchors
+
+
+def _assign_anchors_batch(anchors, batch, rng, cfg: Config):
+    """vmapped assign_anchor over the batch (train-mode RPN targets)."""
+    b = batch["image"].shape[0]
+    return jax.vmap(
+        partial(
+            assign_anchor,
+            rpn_batch_size=cfg.train.rpn_batch_size,
+            rpn_fg_fraction=cfg.train.rpn_fg_fraction,
+            positive_overlap=cfg.train.rpn_positive_overlap,
+            negative_overlap=cfg.train.rpn_negative_overlap,
+            allowed_border=cfg.train.rpn_allowed_border,
+            clobber_positives=cfg.train.rpn_clobber_positives,
+        ),
+        in_axes=(None, 0, 0, 0, 0),
+    )(anchors, batch["gt_boxes"], batch["gt_valid"], batch["im_info"],
+      jax.random.split(rng, b))
+
+
+def forward_train(
+    model: FasterRCNN,
+    params,
+    batch: Dict[str, jnp.ndarray],
+    rng: jax.Array,
+    cfg: Config,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One fused train forward: images → total loss + metric auxiliaries.
+
+    batch keys: image (B,H,W,3) float32 (mean-subtracted), im_info (B,3),
+    gt_boxes (B,G,4), gt_classes (B,G) int32, gt_valid (B,G) bool.
+    """
+    images = batch["image"]
+    im_info = batch["im_info"]
+    b = images.shape[0]
+    a = model.num_anchors
+    stride = cfg.network.rpn_feat_stride
+
+    feat, rpn_cls_logits, rpn_bbox_deltas, anchors = _backbone_rpn(
+        model, params, images, cfg)
+
+    # --- RPN targets (reference: assign_anchor on host in AnchorLoader) ---
+    k_anchor, k_sample, k_drop = jax.random.split(rng, 3)
+    rpn_t = _assign_anchors_batch(anchors, batch, k_anchor, cfg)
+
+    rpn_l = rpn_losses(
+        _pair_logits(rpn_cls_logits, a),
+        rpn_bbox_deltas.reshape(b, -1, 4),
+        rpn_t.labels,
+        rpn_t.bbox_targets,
+        rpn_t.bbox_weights,
+        cfg.train.rpn_batch_size,
+    )
+
+    # --- Proposals (reference: Proposal op; gradients do not flow) ---
+    rpn_prob = _rpn_softmax(jax.lax.stop_gradient(rpn_cls_logits), a)
+    rois, roi_valid, _ = generate_proposals(
+        rpn_prob,
+        jax.lax.stop_gradient(rpn_bbox_deltas),
+        im_info,
+        anchors,
+        pre_nms_top_n=cfg.train.rpn_pre_nms_top_n,
+        post_nms_top_n=cfg.train.rpn_post_nms_top_n,
+        nms_thresh=cfg.train.rpn_nms_thresh,
+        min_size=cfg.train.rpn_min_size,
+        feat_stride=stride,
+    )
+
+    # --- ROI sampling (reference: ProposalTarget op — host numpy there) ---
+    samples = jax.vmap(
+        partial(
+            sample_rois,
+            num_classes=model.num_classes,
+            batch_rois=cfg.train.batch_rois,
+            fg_fraction=cfg.train.fg_fraction,
+            fg_thresh=cfg.train.fg_thresh,
+            bg_thresh_hi=cfg.train.bg_thresh_hi,
+            bg_thresh_lo=cfg.train.bg_thresh_lo,
+            bbox_means=cfg.train.bbox_means,
+            bbox_stds=cfg.train.bbox_stds,
+        ),
+    )(rois, roi_valid, batch["gt_boxes"], batch["gt_classes"], batch["gt_valid"],
+      jax.random.split(k_sample, b))
+
+    r = cfg.train.batch_rois
+    pooled = _pool_rois(feat, samples.rois, samples.valid,
+                        model.roi_pool_size, model.roi_pool_type)
+    cls_logits, bbox_deltas = model.apply(
+        params, pooled, False, method=FasterRCNN.box_head,
+        rngs={"dropout": k_drop})
+
+    labels = jnp.where(samples.valid.reshape(-1), samples.labels.reshape(-1), -1)
+    rcnn_l = rcnn_losses(
+        cls_logits,
+        bbox_deltas,
+        labels,
+        samples.bbox_targets.reshape(b * r, -1),
+        samples.bbox_weights.reshape(b * r, -1),
+        cfg.train.batch_rois,
+        b,
+    )
+
+    total = (rpn_l["rpn_cls_loss"] + rpn_l["rpn_bbox_loss"]
+             + rcnn_l["rcnn_cls_loss"] + rcnn_l["rcnn_bbox_loss"])
+
+    aux = {
+        "rpn_cls_loss": rpn_l["rpn_cls_loss"],
+        "rpn_bbox_loss": rpn_l["rpn_bbox_loss"],
+        "rcnn_cls_loss": rcnn_l["rcnn_cls_loss"],
+        "rcnn_bbox_loss": rcnn_l["rcnn_bbox_loss"],
+        "total_loss": total,
+        # Metric auxiliaries (train/metrics.py — the reference's 6 metrics).
+        "rpn_logits": _pair_logits(rpn_cls_logits, a),
+        "rpn_labels": rpn_t.labels,
+        "rcnn_logits": cls_logits,
+        "rcnn_labels": labels,
+        "num_fg": jnp.sum(samples.fg_mask),
+    }
+    return total, aux
+
+
+def forward_test(
+    model: FasterRCNN,
+    params,
+    images: jnp.ndarray,
+    im_info: jnp.ndarray,
+    cfg: Config,
+):
+    """Test forward: images → (rois, roi_scores (B,R,C), pred_boxes (B,R,4C)).
+
+    Reference: get_*_test symbol + rcnn/core/tester.py::im_detect. Box
+    decoding (bbox_pred → clip) happens here on device; per-class NMS lives
+    in ops/detection.py (the reference does all of it on host).
+    """
+    a = model.num_anchors
+    stride = cfg.network.rpn_feat_stride
+    feat, rpn_cls_logits, rpn_bbox_deltas, anchors = _backbone_rpn(
+        model, params, images, cfg)
+    rpn_prob = _rpn_softmax(rpn_cls_logits, a)
+    rois, roi_valid, _ = generate_proposals(
+        rpn_prob, rpn_bbox_deltas, im_info, anchors,
+        pre_nms_top_n=cfg.test.rpn_pre_nms_top_n,
+        post_nms_top_n=cfg.test.rpn_post_nms_top_n,
+        nms_thresh=cfg.test.rpn_nms_thresh,
+        min_size=cfg.test.rpn_min_size,
+        feat_stride=stride,
+    )
+    b, r = rois.shape[0], rois.shape[1]
+    pooled = _pool_rois(feat, rois, roi_valid,
+                        model.roi_pool_size, model.roi_pool_type)
+    cls_logits, bbox_deltas = model.apply(
+        params, pooled, True, method=FasterRCNN.box_head)
+    scores = jax.nn.softmax(cls_logits, axis=-1).reshape(b, r, -1)
+    # Un-normalize deltas (reference folds means/stds into saved weights at
+    # checkpoint time — rcnn/core/callback.py do_checkpoint; we keep weights
+    # normalized and decode explicitly, see train/checkpoint.py contract).
+    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
+                    model.num_classes)
+    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
+                     model.num_classes)
+    deltas = bbox_deltas.reshape(b, r, -1) * stds + means
+    boxes = jax.vmap(bbox_pred)(rois, deltas)  # (B, R, 4C)
+    boxes = jax.vmap(lambda bx, ii: clip_boxes(bx, (ii[0], ii[1])))(boxes, im_info)
+    scores = scores * roi_valid[..., None].astype(scores.dtype)
+    return rois, roi_valid, scores, boxes
+
+
+def forward_train_rpn(
+    model: FasterRCNN,
+    params,
+    batch: Dict[str, jnp.ndarray],
+    rng: jax.Array,
+    cfg: Config,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """RPN-only training forward (alternate-optimization stages 1 and 4).
+
+    Reference: the rpn-only symbols get_*_rpn + rcnn/tools/train_rpn.py.
+    Same batch contract as forward_train; only the RPN pair of losses.
+    """
+    images = batch["image"]
+    b = images.shape[0]
+    a = model.num_anchors
+    feat, rpn_cls_logits, rpn_bbox_deltas, anchors = _backbone_rpn(
+        model, params, images, cfg)
+    rpn_t = _assign_anchors_batch(anchors, batch, rng, cfg)
+    rpn_l = rpn_losses(
+        _pair_logits(rpn_cls_logits, a),
+        rpn_bbox_deltas.reshape(b, -1, 4),
+        rpn_t.labels, rpn_t.bbox_targets, rpn_t.bbox_weights,
+        cfg.train.rpn_batch_size,
+    )
+    total = rpn_l["rpn_cls_loss"] + rpn_l["rpn_bbox_loss"]
+    aux = {
+        "rpn_cls_loss": rpn_l["rpn_cls_loss"],
+        "rpn_bbox_loss": rpn_l["rpn_bbox_loss"],
+        "total_loss": total,
+        "rpn_logits": _pair_logits(rpn_cls_logits, a),
+        "rpn_labels": rpn_t.labels,
+    }
+    return total, aux
+
+
+def forward_train_rcnn(
+    model: FasterRCNN,
+    params,
+    batch: Dict[str, jnp.ndarray],
+    rng: jax.Array,
+    cfg: Config,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Fast-R-CNN training forward over PRECOMPUTED proposals.
+
+    Reference: get_*_rcnn symbols + rcnn/tools/train_rcnn.py over ROIIter
+    (selective-search or stage-RPN proposals). Batch additionally carries
+    proposals (B, P, 4) + proposal_valid (B, P).
+    """
+    images = batch["image"]
+    b = images.shape[0]
+    feat = model.apply(params, images, method=FasterRCNN.extract)
+    k_sample, k_drop = jax.random.split(rng)
+    samples = jax.vmap(
+        partial(
+            sample_rois,
+            num_classes=model.num_classes,
+            batch_rois=cfg.train.batch_rois,
+            fg_fraction=cfg.train.fg_fraction,
+            fg_thresh=cfg.train.fg_thresh,
+            bg_thresh_hi=cfg.train.bg_thresh_hi,
+            bg_thresh_lo=cfg.train.bg_thresh_lo,
+            bbox_means=cfg.train.bbox_means,
+            bbox_stds=cfg.train.bbox_stds,
+        ),
+    )(batch["proposals"], batch["proposal_valid"], batch["gt_boxes"],
+      batch["gt_classes"], batch["gt_valid"], jax.random.split(k_sample, b))
+    r = cfg.train.batch_rois
+    pooled = _pool_rois(feat, samples.rois, samples.valid,
+                        model.roi_pool_size, model.roi_pool_type)
+    cls_logits, bbox_deltas = model.apply(
+        params, pooled, False, method=FasterRCNN.box_head,
+        rngs={"dropout": k_drop})
+    labels = jnp.where(samples.valid.reshape(-1), samples.labels.reshape(-1), -1)
+    rcnn_l = rcnn_losses(
+        cls_logits, bbox_deltas, labels,
+        samples.bbox_targets.reshape(b * r, -1),
+        samples.bbox_weights.reshape(b * r, -1),
+        cfg.train.batch_rois, b,
+    )
+    total = rcnn_l["rcnn_cls_loss"] + rcnn_l["rcnn_bbox_loss"]
+    aux = {
+        "rcnn_cls_loss": rcnn_l["rcnn_cls_loss"],
+        "rcnn_bbox_loss": rcnn_l["rcnn_bbox_loss"],
+        "total_loss": total,
+        "rcnn_logits": cls_logits,
+        "rcnn_labels": labels,
+        "num_fg": jnp.sum(samples.fg_mask),
+    }
+    return total, aux
+
+
+def forward_rpn(
+    model: FasterRCNN,
+    params,
+    images: jnp.ndarray,
+    im_info: jnp.ndarray,
+    cfg: Config,
+):
+    """RPN-only forward → (rois, roi_valid, roi_scores).
+
+    The proposal-generation path of the alternate-training pipeline
+    (reference: tools/test_rpn.py → tester.py im_proposal), skipping the box
+    head entirely — proposals cost only backbone + RPN.
+    """
+    a = model.num_anchors
+    feat, rpn_cls_logits, rpn_bbox_deltas, anchors = _backbone_rpn(
+        model, params, images, cfg)
+    rpn_prob = _rpn_softmax(rpn_cls_logits, a)
+    # PROPOSAL_* counts, not the detection-path RPN counts: the dump feeds
+    # Fast-R-CNN training, which samples from ~2000 candidates per image
+    # (reference TEST.PROPOSAL_PRE/POST_NMS_TOP_N).
+    return generate_proposals(
+        rpn_prob, rpn_bbox_deltas, im_info, anchors,
+        pre_nms_top_n=cfg.test.proposal_pre_nms_top_n,
+        post_nms_top_n=cfg.test.proposal_post_nms_top_n,
+        nms_thresh=cfg.test.proposal_nms_thresh,
+        min_size=cfg.test.rpn_min_size,
+        feat_stride=cfg.network.rpn_feat_stride,
+    )
+
+
+def build_model(cfg: Config) -> FasterRCNN:
+    return FasterRCNN(
+        backbone="vgg" if cfg.network.name == "vgg" else f"resnet{cfg.network.depth}",
+        num_classes=cfg.dataset.num_classes,
+        num_anchors=cfg.network.num_anchors,
+        roi_pool_size=cfg.network.roi_pool_size,
+        roi_pool_type=cfg.network.roi_pool_type,
+        dtype=jnp.dtype(cfg.network.compute_dtype),
+    )
+
+
+def init_params(model: FasterRCNN, cfg: Config, rng: jax.Array,
+                image_shape=None):
+    """Initialize the full parameter tree on tiny shapes (shape-polymorphic
+    convs make the real padded shape unnecessary at init)."""
+    h, w = image_shape or (64, 64)
+    images = jnp.zeros((1, h, w, 3), jnp.float32)
+    rois = jnp.asarray([[0.0, 0.0, 0.0, 31.0, 31.0]], jnp.float32)
+    return model.init(rng, images, rois)

@@ -1,0 +1,41 @@
+"""RPN head.
+
+Reference: the rpn_conv/rpn_cls_score/rpn_bbox_pred trio built inline in
+rcnn/symbol/symbol_vgg.py and symbol_resnet.py: 3x3 conv (512) + relu, then
+two sibling 1x1 convs producing 2A objectness logits and 4A box deltas.
+
+TPU delta: outputs are NHWC with channels last — (B, H, W, 2A) and
+(B, H, W, 4A) — matching ops/proposal.py's expected layout. The per-pixel
+channel order is [bg x A, fg x A] for scores (so ``[..., A:]`` is fg) and A
+groups of 4 for deltas, consistent with ops/anchors.anchor_grid ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class RPNHead(nn.Module):
+    num_anchors: int = 9
+    channels: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, feat: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        a = self.num_anchors
+        x = nn.Conv(self.channels, (3, 3), padding=[(1, 1), (1, 1)],
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name="rpn_conv")(feat.astype(self.dtype))
+        x = nn.relu(x)
+        cls_logits = nn.Conv(2 * a, (1, 1), dtype=self.dtype,
+                             param_dtype=jnp.float32,
+                             kernel_init=nn.initializers.normal(0.01),
+                             name="rpn_cls_score")(x)
+        bbox_deltas = nn.Conv(4 * a, (1, 1), dtype=self.dtype,
+                              param_dtype=jnp.float32,
+                              kernel_init=nn.initializers.normal(0.01),
+                              name="rpn_bbox_pred")(x)
+        return cls_logits.astype(jnp.float32), bbox_deltas.astype(jnp.float32)

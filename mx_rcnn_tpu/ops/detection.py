@@ -1,0 +1,77 @@
+"""Final detection post-processing — per-class NMS, on device.
+
+Reference: the host-side loop in rcnn/core/tester.py::pred_eval (per class:
+score threshold → NMS(0.3) → all_boxes, then a max_per_image cap across
+classes). On TPU this is a vmapped static-shape op inside jit, so the whole
+test forward produces ready detections and only one small tensor crosses to
+the host per batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.nms import nms_bitmask
+
+
+class Detections(NamedTuple):
+    boxes: jnp.ndarray    # (B, M, 4)
+    scores: jnp.ndarray   # (B, M)
+    classes: jnp.ndarray  # (B, M) int32 (1..C-1)
+    valid: jnp.ndarray    # (B, M) bool
+
+
+def multiclass_nms(
+    scores: jnp.ndarray,
+    boxes: jnp.ndarray,
+    roi_valid: jnp.ndarray,
+    *,
+    score_thresh: float = 0.05,
+    nms_thresh: float = 0.3,
+    max_per_image: int = 100,
+) -> Detections:
+    """Batched multi-class NMS.
+
+    Args:
+      scores: (B, R, C) softmax class probabilities (col 0 = background).
+      boxes: (B, R, 4C) per-class decoded boxes.
+      roi_valid: (B, R) bool.
+
+    Returns top max_per_image detections across classes per image.
+    """
+    b, r, c = scores.shape
+
+    def one_image(sc, bx, rv):
+        # per-class arrays, skipping background (class 0).
+        sc_t = sc[:, 1:].T  # (C-1, R)
+        bx_t = bx.reshape(r, c, 4).transpose(1, 0, 2)[1:]  # (C-1, R, 4)
+        valid = (sc_t >= score_thresh) & rv[None, :]
+
+        def per_class(s, bxs, v):
+            keep_idx, keep_valid = nms_bitmask(bxs, s, v, nms_thresh,
+                                               max_per_image)
+            return s[keep_idx] * keep_valid, bxs[keep_idx], keep_valid
+
+        ks, kb, kv = jax.vmap(per_class)(sc_t, bx_t, valid)  # (C-1, M, ...)
+        cls_ids = jnp.broadcast_to(
+            jnp.arange(1, c, dtype=jnp.int32)[:, None], ks.shape)
+        flat_s = ks.reshape(-1)
+        flat_b = kb.reshape(-1, 4)
+        flat_c = cls_ids.reshape(-1)
+        flat_v = kv.reshape(-1)
+        # max_per_image cap ACROSS classes (reference: the image_scores sort
+        # + threshold in pred_eval).
+        top_s, top_i = jax.lax.top_k(
+            jnp.where(flat_v, flat_s, -1.0), max_per_image)
+        return Detections(
+            boxes=flat_b[top_i],
+            scores=top_s,
+            classes=flat_c[top_i],
+            valid=top_s > 0,
+        )
+
+    return jax.vmap(one_image)(scores, boxes, roi_valid)

@@ -62,7 +62,10 @@ def generate_proposals(
 
     Returns:
       rois: (B, post_nms_top_n, 4) image-coordinate boxes,
-      roi_valid: (B, post_nms_top_n) bool.
+      roi_valid: (B, post_nms_top_n) bool,
+      roi_scores: (B, post_nms_top_n) float32 RPN fg scores (0 on padding) —
+        the reference's Proposal op drops scores in-graph but the alternate
+        -training proposal dump (tester.py::generate_proposals) saves them.
     """
     b, h, w, twice_a = rpn_cls_prob.shape
     a = twice_a // 2
@@ -106,7 +109,8 @@ def _proposals_one_image(
         top_boxes, top_scores, top_valid, nms_thresh, post_nms_top_n
     )
     rois = top_boxes[keep_idx]
+    roi_scores = jnp.where(keep_valid, top_scores[keep_idx], 0.0)
     # Pad invalid slots with the first (highest-score) kept roi so downstream
     # pooling reads a real box; validity mask excludes them from sampling.
     rois = jnp.where(keep_valid[:, None], rois, rois[0][None, :])
-    return rois, keep_valid
+    return rois, keep_valid, roi_scores

@@ -1,0 +1,23 @@
+"""Distributed backend — the KVStore replacement.
+
+Reference: MXNet KVStore (`local`/`device`/`dist_sync` — C++ ps-lite) plus
+DataParallelExecutorGroup batch slicing (SURVEY.md §2 L0, §3 'KVStore / comm
+backend'). Here: one `jax.sharding.Mesh`, batch sharded on the `data` axis,
+parameters replicated, gradient allreduce inserted by XLA over ICI/DCN.
+"""
+
+from mx_rcnn_tpu.parallel.mesh import (
+    batch_sharding,
+    create_mesh,
+    parse_mesh_shape,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "create_mesh",
+    "parse_mesh_shape",
+    "batch_sharding",
+    "replicated",
+    "shard_batch",
+]

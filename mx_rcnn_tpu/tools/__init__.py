@@ -1,0 +1,2 @@
+"""Stage drivers (reference: rcnn/tools/ — train_rpn, train_rcnn, test_rpn,
+test_rcnn, reeval) plus the shared fit loop used by every entry point."""

@@ -1,0 +1,147 @@
+"""Alternate-optimization stage drivers.
+
+Reference: rcnn/tools/train_rpn.py, test_rpn.py, train_rcnn.py,
+test_rcnn.py — the four stage entry points chained by train_alternate.py
+(SURVEY.md §4.4). Stages communicate via files: orbax checkpoints + proposal
+pickles, exactly like the reference's .params + *_rpn.pkl contract.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.data.datasets import get_dataset
+from mx_rcnn_tpu.data.loader import ROIIter, TestLoader
+from mx_rcnn_tpu.evaluation.tester import (
+    Predictor,
+    generate_proposals,
+    pred_eval,
+)
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models.faster_rcnn import (
+    build_model,
+    forward_train_rcnn,
+    forward_train_rpn,
+    init_params,
+)
+from mx_rcnn_tpu.tools.train import fit_detector, load_gt_roidbs
+from mx_rcnn_tpu.train.checkpoint import load_checkpoint
+
+# The conv trunk freeze for stages 4/6 (reference: train_alternate.py passes
+# the full backbone prefix list in stage-2 training).
+TRUNK_PATTERNS = ("features",)
+
+
+def train_rpn(cfg: Config, prefix: str, pretrained_params=None,
+              end_epoch: Optional[int] = None, frozen_trunk: bool = False,
+              mesh_spec: str = "", frequent: int = 20, seed: int = 0):
+    """RPN-only fit (reference: tools/train_rpn.py)."""
+    roidb = load_gt_roidbs(cfg)
+    return fit_detector(
+        cfg, roidb, prefix,
+        end_epoch=end_epoch,
+        frequent=frequent,
+        pretrained_params=pretrained_params,
+        mesh_spec=mesh_spec,
+        seed=seed,
+        forward_fn=forward_train_rpn,
+        fixed_param_patterns=TRUNK_PATTERNS if frozen_trunk else None,
+    )
+
+
+def test_rpn_generate(cfg: Config, params, rpn_file: str,
+                      image_set: Optional[str] = None):
+    """Dump RPN proposals for an image set (reference: tools/test_rpn.py
+    --gen → tester.generate_proposals)."""
+    image_set = image_set or cfg.dataset.image_set
+    sets = image_set.split("+")
+    model = build_model(cfg)
+    predictor = Predictor(model, params, cfg)
+    files = []
+    for s in sets:
+        ds = get_dataset(cfg.dataset.name, s, cfg.dataset.root_path,
+                         cfg.dataset.dataset_path)
+        roidb = ds.gt_roidb()
+        loader = TestLoader(roidb, cfg, batch_size=1)
+        f = rpn_file if len(sets) == 1 else f"{rpn_file}.{s}"
+        generate_proposals(predictor, loader, f)
+        files.append(f)
+    return files
+
+
+def _attach_proposals(cfg: Config, rpn_file: str) -> List[Dict]:
+    """gt roidb + dumped proposals → Fast-RCNN roidb (with flip doubling;
+    proposals for flipped copies are mirrored at load time by ROIIter)."""
+    image_set = cfg.dataset.image_set
+    sets = image_set.split("+")
+    out = []
+    for s in sets:
+        ds = get_dataset(cfg.dataset.name, s, cfg.dataset.root_path,
+                         cfg.dataset.dataset_path)
+        gt = ds.gt_roidb()
+        f = rpn_file if len(sets) == 1 else f"{rpn_file}.{s}"
+        merged = ds.rpn_roidb(gt, f)
+        if cfg.train.flip:
+            merged = ds.append_flipped_images(merged)
+        out.extend([r for r in merged if len(r["boxes"])])
+    return out
+
+
+def train_rcnn(cfg: Config, prefix: str, rpn_file: str,
+               pretrained_params=None, end_epoch: Optional[int] = None,
+               frozen_trunk: bool = False, mesh_spec: str = "",
+               frequent: int = 20, seed: int = 0, max_proposals: int = 2000):
+    """Fast-R-CNN fit over precomputed proposals (reference:
+    tools/train_rcnn.py over ROIIter)."""
+    roidb = _attach_proposals(cfg, rpn_file)
+    return fit_detector(
+        cfg, roidb, prefix,
+        end_epoch=end_epoch,
+        frequent=frequent,
+        pretrained_params=pretrained_params,
+        mesh_spec=mesh_spec,
+        seed=seed,
+        forward_fn=forward_train_rcnn,
+        loader_factory=partial(_roiiter_factory, max_proposals=max_proposals,
+                               seed=seed),
+        fixed_param_patterns=TRUNK_PATTERNS if frozen_trunk else None,
+    )
+
+
+def _roiiter_factory(roidb, cfg, num_shards, max_proposals=2000, seed=0):
+    return ROIIter(roidb, cfg, num_shards, max_proposals=max_proposals,
+                   seed=seed)
+
+
+def test_rcnn(cfg: Config, prefix: str, epoch: int,
+              image_set: Optional[str] = None, thresh: float = 1e-3):
+    """Evaluate a checkpoint (reference: tools/test_rcnn.py)."""
+    image_set = image_set or cfg.dataset.test_image_set
+    ds = get_dataset(cfg.dataset.name, image_set, cfg.dataset.root_path,
+                     cfg.dataset.dataset_path)
+    roidb = ds.gt_roidb()
+    model = build_model(cfg)
+    template = init_params(model, cfg, jax.random.PRNGKey(0))
+    params, _ = load_checkpoint(
+        prefix, epoch, template={"params": template},
+        means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+        num_classes=cfg.dataset.num_classes)
+    predictor = Predictor(model, params, cfg)
+    loader = TestLoader(roidb, cfg, batch_size=1)
+    return pred_eval(predictor, loader, ds, thresh=thresh)
+
+
+def reeval(imdb, detections_pkl: str):
+    """Re-run evaluation on saved detections (reference: tools/reeval.py)."""
+    import pickle
+
+    with open(detections_pkl, "rb") as f:
+        all_boxes = pickle.load(f)
+    results = imdb.evaluate_detections(all_boxes)
+    logger.info("reeval: %s", results)
+    return results

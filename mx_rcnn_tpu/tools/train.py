@@ -1,0 +1,150 @@
+"""Shared training driver — the `MutableModule.fit` analog.
+
+Reference: the body of train_end2end.py::train_net (SURVEY.md §4.1): roidb
+load → AnchorLoader → param init/resume → fit with metrics, Speedometer,
+epoch checkpoints. All entry points (end2end, rpn-only, rcnn-only stages)
+funnel through `fit_detector`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.data.datasets import get_dataset
+from mx_rcnn_tpu.data.datasets.imdb import filter_roidb, merge_roidb
+from mx_rcnn_tpu.data.loader import AnchorLoader
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models.faster_rcnn import build_model, init_params
+from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
+from mx_rcnn_tpu.train.callback import Speedometer
+from mx_rcnn_tpu.train.checkpoint import (
+    latest_epoch,
+    load_checkpoint,
+    save_checkpoint,
+)
+from mx_rcnn_tpu.train.metrics import MetricBag
+from mx_rcnn_tpu.train.optimizer import build_optimizer
+from mx_rcnn_tpu.train.step import create_train_state, make_train_step
+
+
+def load_gt_roidbs(cfg: Config, image_set: Optional[str] = None,
+                   flip: Optional[bool] = None) -> List[Dict]:
+    """'07_trainval+12_trainval'-style multi-set load (reference:
+    rcnn/utils/load_data.py::load_gt_roidb + merge_roidb)."""
+    image_set = image_set or cfg.dataset.image_set
+    flip = cfg.train.flip if flip is None else flip
+    roidbs = []
+    for s in image_set.split("+"):
+        ds = get_dataset(cfg.dataset.name, s, cfg.dataset.root_path,
+                         cfg.dataset.dataset_path)
+        roidb = ds.gt_roidb()
+        if flip:
+            roidb = ds.append_flipped_images(roidb)
+        roidbs.append(roidb)
+    return filter_roidb(merge_roidb(roidbs))
+
+
+def fit_detector(
+    cfg: Config,
+    roidb: List[Dict],
+    prefix: str,
+    begin_epoch: int = 0,
+    end_epoch: Optional[int] = None,
+    frequent: int = 20,
+    resume: bool = False,
+    pretrained_params=None,
+    mesh_spec: Optional[str] = None,
+    seed: int = 0,
+    epoch_callback: Optional[Callable] = None,
+    forward_fn=None,
+    loader_factory: Optional[Callable] = None,
+    fixed_param_patterns=None,
+):
+    """Train loop. Returns the final (host) params tree.
+
+    forward_fn selects the training graph (end2end default; rpn-only /
+    rcnn-only for the alternate stages); loader_factory builds the data
+    iterator (AnchorLoader default, ROIIter for Fast R-CNN);
+    fixed_param_patterns extends the frozen set (alternate stages 4/6 freeze
+    the shared conv trunk — reference train_alternate.py).
+    """
+    end_epoch = end_epoch or cfg.train.end_epoch
+    mesh = create_mesh(mesh_spec or cfg.mesh.mesh_shape)
+    n_data = mesh.shape["data"]
+    logger.info("mesh: %s (data=%d model=%d)", mesh.devices.shape,
+                n_data, mesh.shape["model"])
+
+    if fixed_param_patterns is not None:
+        from dataclasses import replace as _replace
+        cfg = cfg.with_updates(network=_replace(
+            cfg.network,
+            fixed_param_patterns=tuple(cfg.network.fixed_param_patterns)
+            + tuple(fixed_param_patterns)))
+
+    model = build_model(cfg)
+    params = pretrained_params or init_params(
+        model, cfg, jax.random.PRNGKey(seed))
+    if loader_factory is None:
+        loader = AnchorLoader(roidb, cfg, num_shards=n_data, seed=seed)
+    else:
+        loader = loader_factory(roidb, cfg, n_data)
+    steps_per_epoch = max(len(loader), 1)
+
+    # Resume discovery BEFORE building the optimizer: a restored opt_state
+    # carries optax's schedule counter; without one the LR schedule is
+    # offset by begin_step instead (never both — that would double-count).
+    resume_epoch = latest_epoch(prefix) if resume else None
+    opt_state = None
+    if resume_epoch is not None:
+        begin_epoch = resume_epoch
+        tx = build_optimizer(cfg, params, steps_per_epoch)
+        params, opt_state = load_checkpoint(
+            prefix, resume_epoch,
+            template={"params": params},
+            opt_state_template=tx.init(params),
+            means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+            num_classes=cfg.dataset.num_classes)
+        logger.info("resumed from %s epoch %d (opt_state %s)", prefix,
+                    resume_epoch, "restored" if opt_state is not None
+                    else "reinitialized")
+        if opt_state is None:
+            tx = build_optimizer(cfg, params, steps_per_epoch,
+                                 begin_step=begin_epoch * steps_per_epoch)
+    else:
+        tx = build_optimizer(cfg, params, steps_per_epoch,
+                             begin_step=begin_epoch * steps_per_epoch)
+
+    state = create_train_state(params, tx)
+    if opt_state is not None:
+        state = state.replace(opt_state=opt_state)
+    if begin_epoch:
+        state = state.replace(
+            step=jax.numpy.asarray(begin_epoch * steps_per_epoch,
+                                   jax.numpy.int32))
+
+    from mx_rcnn_tpu.models.faster_rcnn import forward_train
+    step_fn = make_train_step(model, cfg, mesh=mesh,
+                              forward_fn=forward_fn or forward_train)
+    rng = jax.random.PRNGKey(seed + 1)
+    batch_size = cfg.train.batch_images * n_data
+    speedometer = Speedometer(batch_size, frequent)
+
+    for epoch in range(begin_epoch, end_epoch):
+        bag = MetricBag()
+        for i, batch in enumerate(loader):
+            rng, k = jax.random.split(rng)
+            state, metrics = step_fn(state, shard_batch(batch, mesh), k)
+            bag.update(metrics)
+            speedometer(epoch, i, bag)
+        logger.info("Epoch[%d] done. %s", epoch, bag.format())
+        save_checkpoint(
+            prefix, epoch + 1, state.params, state.opt_state,
+            means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+            num_classes=cfg.dataset.num_classes)
+        if epoch_callback:
+            epoch_callback(epoch, state)
+    return jax.device_get(state.params)

@@ -1,0 +1,22 @@
+"""Training core.
+
+Reference layer L8 (SURVEY.md §2): rcnn/core/module.py MutableModule,
+rcnn/core/metric.py (6 metrics), rcnn/core/callback.py (Speedometer,
+do_checkpoint). Here: an optax optimizer with reference hyperparameters, a
+pjit-able train step, host-side metric accumulators, and orbax checkpoints.
+"""
+
+from mx_rcnn_tpu.train.optimizer import build_optimizer, trainable_mask
+from mx_rcnn_tpu.train.step import TrainState, create_train_state, make_train_step
+from mx_rcnn_tpu.train.metrics import MetricBag
+from mx_rcnn_tpu.train.callback import Speedometer
+
+__all__ = [
+    "build_optimizer",
+    "trainable_mask",
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "MetricBag",
+    "Speedometer",
+]

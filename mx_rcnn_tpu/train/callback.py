@@ -1,0 +1,33 @@
+"""Speedometer — the reference's only perf instrumentation, kept log-compatible.
+
+Reference: rcnn/core/callback.py::Speedometer(batch_size, frequent) logging
+'Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s' — the samples/sec line is
+the throughput number BASELINE.md tracks, so the format is preserved.
+"""
+
+from __future__ import annotations
+
+import time
+
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.train.metrics import MetricBag
+
+
+class Speedometer:
+    def __init__(self, batch_size: int, frequent: int = 20):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._tic = time.time()
+        self._count = 0
+
+    def __call__(self, epoch: int, batch: int, metrics: MetricBag):
+        self._count += 1
+        if self._count % self.frequent == 0:
+            speed = self.frequent * self.batch_size / (time.time() - self._tic)
+            logger.info(
+                "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s",
+                epoch, batch, speed, metrics.format(),
+            )
+            self._tic = time.time()
+            return speed
+        return None

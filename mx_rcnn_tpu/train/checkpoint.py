@@ -1,0 +1,134 @@
+"""Checkpointing — orbax, with the reference's bbox_pred (un)normalization contract.
+
+Reference: rcnn/core/callback.py::do_checkpoint saves per-epoch
+``prefix-%04d.params`` after multiplying the bbox_pred weights by the target
+stds (+ means into the bias) so saved checkpoints predict RAW deltas;
+train_end2end.py's ``--resume`` and test-time load_param RE-normalize.
+
+This build's contract (the SURVEY.md §6 'document the choice' option):
+in-memory parameters ALWAYS predict normalized deltas; checkpoints on disk
+ALWAYS store the raw-delta (un-normalized) form, exactly like the reference's
+.params files. `save_checkpoint` folds stds/means in; `load_checkpoint` folds
+them back out. Test-time decode multiplies by stds explicitly
+(models/faster_rcnn.py::forward_test), so an on-disk checkpoint loaded for
+inference via load_checkpoint round-trips to identical predictions.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import orbax.checkpoint as ocp
+
+from mx_rcnn_tpu.logger import logger
+
+
+def _map_bbox_pred(params, fn_kernel, fn_bias):
+    """Apply fns to the bbox_pred Dense leaves, leave everything else."""
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if "bbox_pred" in path:
+            if path[-1] == "kernel":
+                return fn_kernel(tree)
+            if path[-1] == "bias":
+                return fn_bias(tree)
+        return tree
+
+    return walk(params)
+
+
+def unnormalize_bbox_params(params, means: Sequence[float], stds: Sequence[float],
+                            num_classes: int):
+    """Fold stds/means INTO bbox_pred so it predicts raw deltas (save form)."""
+    stds_t = np.tile(np.asarray(stds, np.float32), num_classes)
+    means_t = np.tile(np.asarray(means, np.float32), num_classes)
+    return _map_bbox_pred(
+        params,
+        lambda k: k * stds_t[None, :],
+        lambda b: b * stds_t + means_t,
+    )
+
+
+def renormalize_bbox_params(params, means: Sequence[float], stds: Sequence[float],
+                            num_classes: int):
+    """Inverse of unnormalize_bbox_params (load form)."""
+    stds_t = np.tile(np.asarray(stds, np.float32), num_classes)
+    means_t = np.tile(np.asarray(means, np.float32), num_classes)
+    return _map_bbox_pred(
+        params,
+        lambda k: k / stds_t[None, :],
+        lambda b: (b - means_t) / stds_t,
+    )
+
+
+def save_checkpoint(prefix: str, epoch: int, params, opt_state=None, *,
+                    means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2),
+                    num_classes: Optional[int] = None):
+    """Save epoch checkpoint at <prefix>/<epoch>/ (raw-delta form).
+
+    opt_state is saved alongside when given (the reference cannot resume
+    optimizer momentum — we can; --resume uses it when present).
+    """
+    path = os.path.abspath(os.path.join(prefix, f"{epoch:04d}"))
+    to_save = {"params": jax.device_get(params)}
+    if num_classes is not None:
+        to_save["params"] = unnormalize_bbox_params(
+            to_save["params"], means, stds, num_classes)
+    if opt_state is not None:
+        to_save["opt_state"] = jax.device_get(opt_state)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, to_save, force=True)
+    logger.info("Saved checkpoint to %s", path)
+    return path
+
+
+def load_checkpoint(prefix: str, epoch: int, *, template=None,
+                    opt_state_template=None,
+                    means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2),
+                    num_classes: Optional[int] = None):
+    """Load epoch checkpoint; returns (params, opt_state_or_None).
+
+    Re-normalizes bbox_pred (reference: load_param + re-normalization under
+    --resume in train_end2end.py). opt_state_template is REQUIRED to get a
+    usable opt_state back: orbax restores untyped pytrees (dicts/lists), and
+    optax states are namedtuples — restore against tx.init(params) or the
+    result is train-step poison.
+    """
+    path = os.path.abspath(os.path.join(prefix, f"{epoch:04d}"))
+    ckptr = ocp.PyTreeCheckpointer()
+    item = None
+    if template is not None:
+        item = {"params": template["params"] if "params" in template
+                else template}
+        if opt_state_template is not None and _has_opt_state(path):
+            item["opt_state"] = opt_state_template
+    restored = ckptr.restore(path, item=item)
+    params = restored["params"]
+    if num_classes is not None:
+        params = renormalize_bbox_params(params, means, stds, num_classes)
+    opt_state = restored.get("opt_state")
+    if opt_state is not None and opt_state_template is None:
+        opt_state = None  # untyped restore is unusable — see docstring
+    return params, opt_state
+
+
+def _has_opt_state(path: str) -> bool:
+    try:
+        return "opt_state" in ocp.PyTreeCheckpointer().metadata(path).tree
+    except Exception:
+        return os.path.isdir(os.path.join(path, "opt_state"))
+
+
+def latest_epoch(prefix: str) -> Optional[int]:
+    """Highest saved epoch under prefix, or None — restart-from-latest support
+    (failure recovery; the reference has none, SURVEY.md §6)."""
+    if not os.path.isdir(prefix):
+        return None
+    epochs = [int(d) for d in os.listdir(prefix) if d.isdigit()]
+    return max(epochs) if epochs else None

@@ -1,0 +1,54 @@
+"""Host-side running metric accumulators.
+
+Reference: rcnn/core/metric.py — RPNAccMetric, RPNLogLossMetric,
+RPNL1LossMetric, RCNNAccMetric, RCNNLogLossMetric, RCNNL1LossMetric, each an
+mx.metric.EvalMetric reading fixed output-group slots and keeping
+(sum, count) running averages printed by Speedometer.
+
+Here the per-batch values are computed on device inside the train step
+(train/step.py::_metrics_from_aux) and this bag just averages scalars —
+no per-batch device→host sync of full tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+METRIC_NAMES = (
+    "RPNAcc", "RPNLogLoss", "RPNL1Loss",
+    "RCNNAcc", "RCNNLogLoss", "RCNNL1Loss",
+)
+
+
+class MetricBag:
+    """Accumulates per-batch metric dicts LAZILY: update() stores the device
+    scalars without forcing a host sync; conversion happens in get() (at
+    Speedometer log time), so the train loop never blocks on metrics."""
+
+    def __init__(self, names: Iterable[str] = METRIC_NAMES):
+        self.names = tuple(names)
+        self.reset()
+
+    def reset(self):
+        self._pending = []
+        self._sums = {n: 0.0 for n in self.names}
+        self._count = 0
+
+    def update(self, metrics: Dict):
+        self._pending.append(metrics)
+
+    def _drain(self):
+        for m in self._pending:
+            for n in self.names:
+                if n in m:
+                    self._sums[n] += float(m[n])
+            self._count += 1
+        self._pending = []
+
+    def get(self) -> Dict[str, float]:
+        self._drain()
+        c = max(self._count, 1)
+        return {n: self._sums[n] / c for n in self.names}
+
+    def format(self) -> str:
+        return "\t".join(f"Train-{n}={v:.6f}" for n, v in self.get().items())

@@ -1,0 +1,81 @@
+"""Optimizer: SGD + momentum with the reference's exact knobs.
+
+Reference: the fit kwargs in train_end2end.py —
+``optimizer='sgd', momentum 0.9, wd 5e-4, clip_gradient 5,
+MultiFactorScheduler(lr_step), rescale_grad=1/batch_size`` — plus parameter
+freezing via ``fixed_param_prefix`` handed to MutableModule.
+
+Mapping:
+- clip_gradient: MXNet clips ELEMENTWISE to [−c, c] → optax.clip.
+- wd: MXNet SGD couples weight decay into the gradient → add_decayed_weights
+  before the momentum step.
+- rescale_grad 1/batch: our losses already normalize per local image and DP
+  gradients are mean-reduced, so no extra rescale is needed (documented
+  equivalence — see models/losses.py).
+- MultiFactorScheduler: piecewise-constant LR dropped by ``lr_factor`` at
+  ``lr_step`` epoch boundaries.
+- freezing: a boolean mask — frozen leaves receive zero updates AND no weight
+  decay (MXNet's fixed_param_names are simply absent from the executor's
+  grad list).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import optax
+
+from mx_rcnn_tpu.config import Config
+
+
+def trainable_mask(params, patterns: Sequence[str]):
+    """True for trainable leaves; False where any pattern is a path substring.
+
+    Frozen-BN params (gamma/beta/moving_*) are always frozen in this
+    framework (reference: use_global_stats + fixed gamma/beta).
+    """
+    always_frozen = ("moving_mean", "moving_var")
+
+    def decide(path) -> bool:
+        keys = [getattr(p, "key", str(p)) for p in path]
+        joined = "/".join(str(k) for k in keys)
+        if any(f in joined for f in always_frozen):
+            return False
+        # BN affine anywhere: frozen (gamma/beta leaf names).
+        leaf = keys[-1] if keys else ""
+        if leaf in ("gamma", "beta"):
+            return False
+        return not any(pat in joined for pat in patterns)
+
+    return jax.tree_util.tree_map_with_path(lambda p, _: decide(p), params)
+
+
+def lr_schedule(cfg: Config, steps_per_epoch: int,
+                begin_step: int = 0) -> optax.Schedule:
+    """MultiFactorScheduler analog: lr × lr_factor at each lr_step epoch.
+
+    begin_step offsets the schedule for restarts whose opt_state (and with
+    it optax's internal step count) was not restored — e.g. --begin_epoch
+    with only a params checkpoint. With a restored opt_state the count
+    resumes by itself and begin_step must stay 0.
+    """
+    boundaries = {
+        int(e * steps_per_epoch): cfg.train.lr_factor for e in cfg.train.lr_step
+    }
+    base = optax.piecewise_constant_schedule(cfg.train.lr, boundaries)
+    if begin_step:
+        return lambda step: base(step + begin_step)
+    return base
+
+
+def build_optimizer(cfg: Config, params, steps_per_epoch: int = 1000,
+                    begin_step: int = 0):
+    mask = trainable_mask(params, cfg.network.fixed_param_patterns)
+    sched = lr_schedule(cfg, steps_per_epoch, begin_step)
+    inner = optax.chain(
+        optax.clip(cfg.train.clip_gradient),
+        optax.add_decayed_weights(cfg.train.wd),
+        optax.sgd(learning_rate=sched, momentum=cfg.train.momentum),
+    )
+    return optax.masked(inner, mask)

@@ -1,0 +1,117 @@
+"""The pjit-able train step — MutableModule.fit's hot loop, TPU-style.
+
+Reference: rcnn/core/module.py MutableModule + the per-batch loop in
+train_end2end.py (SURVEY.md §4.1): forward_backward → KVStore push/pull →
+update. Here the whole thing is ONE jitted SPMD program: loss+grad,
+XLA-inserted gradient allreduce over the mesh `data` axis, optax update.
+
+No rebinding: the reference rebinds executors when a batch outgrows the bound
+shapes (MutableModule's raison d'être); static padded shapes make that
+machinery unnecessary — one compilation per config, period.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN, forward_train
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            opt_state=new_opt,
+        )
+
+
+def create_train_state(params, tx: optax.GradientTransformation) -> TrainState:
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        tx=tx,
+    )
+
+
+def _metrics_from_aux(aux: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Per-batch values of the reference's 6 metrics (rcnn/core/metric.py).
+
+    RPNAcc/RCNNAcc ignore label −1 exactly as the reference metrics mask
+    ignore labels; LogLoss values are the CE means; L1Loss are the scaled
+    smooth-L1 sums. Tolerant of partial aux (rpn-only / rcnn-only stages
+    emit their half, matching the metric lists of tools/train_rpn vs
+    train_rcnn in the reference).
+    """
+    eps = 1e-12
+    out = {"TotalLoss": aux["total_loss"]}
+    if "rpn_logits" in aux:
+        rpn_pred = jnp.argmax(aux["rpn_logits"], axis=-1)
+        rpn_valid = aux["rpn_labels"] >= 0
+        rpn_correct = (rpn_pred == aux["rpn_labels"]) & rpn_valid
+        out["RPNAcc"] = jnp.sum(rpn_correct) / (jnp.sum(rpn_valid) + eps)
+        out["RPNLogLoss"] = aux["rpn_cls_loss"]
+        out["RPNL1Loss"] = aux["rpn_bbox_loss"]
+    if "rcnn_logits" in aux:
+        rcnn_pred = jnp.argmax(aux["rcnn_logits"], axis=-1)
+        rcnn_valid = aux["rcnn_labels"] >= 0
+        rcnn_correct = (rcnn_pred == aux["rcnn_labels"]) & rcnn_valid
+        out["RCNNAcc"] = jnp.sum(rcnn_correct) / (jnp.sum(rcnn_valid) + eps)
+        out["RCNNLogLoss"] = aux["rcnn_cls_loss"]
+        out["RCNNL1Loss"] = aux["rcnn_bbox_loss"]
+        out["NumFg"] = aux["num_fg"].astype(jnp.float32)
+    return out
+
+
+def make_train_step(
+    model: FasterRCNN,
+    cfg: Config,
+    mesh: Optional[Mesh] = None,
+    donate: bool = True,
+    forward_fn: Callable = forward_train,
+) -> Callable[[TrainState, Dict[str, jnp.ndarray], jax.Array],
+              Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Build the jitted train step.
+
+    With a mesh: params/opt_state replicated, batch sharded on `data` —
+    XLA inserts the gradient all-reduce over ICI (the KVStore replacement).
+    Without: plain single-device jit. forward_fn selects the training graph
+    (end2end / rpn-only / rcnn-only — the reference's get_*_train symbol
+    variants).
+    """
+
+    def step(state: TrainState, batch, rng):
+        def loss_fn(params):
+            loss, aux = forward_fn(model, params, batch, rng, cfg)
+            return loss, aux
+
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads)
+        return new_state, _metrics_from_aux(aux)
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P("data"))
+    return jax.jit(
+        step,
+        in_shardings=(repl, data_sh, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )

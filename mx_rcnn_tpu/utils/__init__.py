@@ -1,0 +1,10 @@
+"""Model/data plumbing utilities.
+
+Reference: rcnn/utils/ — load_data.py (covered by data/datasets + tools),
+load_model.py / save_model.py (covered by train/checkpoint.py),
+combine_model.py (here).
+"""
+
+from mx_rcnn_tpu.utils.combine_model import combine_model
+
+__all__ = ["combine_model"]

@@ -1,0 +1,32 @@
+"""Combine RPN + RCNN stage parameters into one detector.
+
+Reference: rcnn/utils/combine_model.py — after 4-stage alternate training,
+merges the stage-2 RPN checkpoint (conv trunk + rpn head) with the stage-2
+RCNN checkpoint (box head + cls/bbox FCs) into the final .params pair.
+
+Param-tree layout (models/faster_rcnn.FasterRCNN):
+  params/features   — conv trunk      ← RPN checkpoint (shared, frozen in
+  params/rpn        — RPN head        ← RPN checkpoint   stage 2 so both
+  params/head       — stage5/fc head  ← RCNN checkpoint  stages agree)
+  params/cls_score, params/bbox_pred  ← RCNN checkpoint
+"""
+
+from __future__ import annotations
+
+RPN_KEYS = ("features", "rpn")
+RCNN_KEYS = ("head", "cls_score", "bbox_pred")
+
+
+def combine_model(rpn_params, rcnn_params):
+    """Merge two full parameter trees subtree-by-subtree."""
+    rpn_p = rpn_params["params"]
+    rcnn_p = rcnn_params["params"]
+    merged = {}
+    for k in rpn_p:
+        if k in RPN_KEYS:
+            merged[k] = rpn_p[k]
+        elif k in RCNN_KEYS:
+            merged[k] = rcnn_p[k]
+        else:  # unknown subtree: prefer the rcnn stage (newest training)
+            merged[k] = rcnn_p[k]
+    return {"params": merged}

@@ -1,0 +1,46 @@
+"""Detection visualization (reference: the vis branch of tester.py::pred_eval
+and demo.py's drawing) — pure-numpy rectangles + PIL save, no cv2 needed."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from mx_rcnn_tpu.logger import logger
+
+
+def draw_detections(img_uint8: np.ndarray, dets: np.ndarray,
+                    class_names) -> np.ndarray:
+    """Overlay (n, 6) [cls, score, x1, y1, x2, y2] detections on an RGB
+    uint8 image."""
+    out = img_uint8.copy()
+    for d in dets:
+        cls, score = int(d[0]), d[1]
+        x1, y1, x2, y2 = (int(round(v)) for v in d[2:6])
+        x1, y1 = max(x1, 0), max(y1, 0)
+        x2 = min(x2, out.shape[1] - 1)
+        y2 = min(y2, out.shape[0] - 1)
+        color = np.array([255, 50, 50], np.uint8)
+        out[y1:y2 + 1, x1:x1 + 3] = color
+        out[y1:y2 + 1, x2 - 2:x2 + 1] = color
+        out[y1:y1 + 3, x1:x2 + 1] = color
+        out[y2 - 2:y2 + 1, x1:x2 + 1] = color
+        name = class_names[cls] if cls < len(class_names) else str(cls)
+        logger.info("det %s score=%.3f box=(%d,%d,%d,%d)",
+                    name, score, x1, y1, x2, y2)
+    return out
+
+
+def save_vis(img_uint8: np.ndarray, dets: np.ndarray, class_names,
+             path: str) -> bool:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    vis = draw_detections(img_uint8, dets, class_names)
+    try:
+        from PIL import Image
+
+        Image.fromarray(vis).save(path)
+        return True
+    except Exception as exc:  # pragma: no cover
+        logger.warning("could not save visualization %s: %s", path, exc)
+        return False

@@ -1,0 +1,66 @@
+"""Evaluate a trained detector on a dataset (reference entry point: test.py).
+
+    python test.py --network resnet101 --dataset coco --image_set val2017 \
+        --prefix model/e2e --epoch 10
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data.datasets import get_dataset
+from mx_rcnn_tpu.data.loader import TestLoader
+from mx_rcnn_tpu.evaluation.tester import Predictor, pred_eval
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models.faster_rcnn import build_model, init_params
+from mx_rcnn_tpu.train.checkpoint import load_checkpoint
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="Test a Faster R-CNN network")
+    p.add_argument("--network", default="resnet101")
+    p.add_argument("--dataset", default="coco")
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--prefix", default="model/e2e")
+    p.add_argument("--epoch", type=int, default=10)
+    p.add_argument("--batch_size", type=int, default=1)
+    p.add_argument("--thresh", type=float, default=1e-3)
+    p.add_argument("--vis", action="store_true")
+    p.add_argument("--out_json", default=None,
+                   help="write COCO-format detections json")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    overrides = {}
+    if args.root_path:
+        overrides["dataset.root_path"] = args.root_path
+    if args.dataset_path:
+        overrides["dataset.dataset_path"] = args.dataset_path
+    cfg = generate_config(args.network, args.dataset, **overrides)
+    image_set = args.image_set or cfg.dataset.test_image_set
+
+    ds = get_dataset(cfg.dataset.name, image_set, cfg.dataset.root_path,
+                     cfg.dataset.dataset_path)
+    roidb = ds.gt_roidb()
+    model = build_model(cfg)
+    template = init_params(model, cfg, jax.random.PRNGKey(0))
+    params, _ = load_checkpoint(
+        args.prefix, args.epoch, template={"params": template},
+        means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+        num_classes=cfg.dataset.num_classes)
+    predictor = Predictor(model, params, cfg)
+    loader = TestLoader(roidb, cfg, batch_size=args.batch_size)
+    results = pred_eval(predictor, loader, ds, vis=args.vis,
+                        thresh=args.thresh, out_json=args.out_json)
+    logger.info("evaluation: %s", results)
+
+
+if __name__ == "__main__":
+    main()

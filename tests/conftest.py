@@ -1,22 +1,26 @@
 """Test configuration: run on a virtual 8-device CPU mesh.
 
 Multi-chip hardware is not available in CI; per SURVEY.md §5 the sharding
-tests run on host-simulated devices. Must set env BEFORE jax import.
+tests run on host-simulated devices.
+
+Gotcha (verified): /root/.axon_site/sitecustomize.py pre-imports jax at
+interpreter startup with JAX_PLATFORMS=axon, so setting the env var here is
+too late for jax's config snapshot — but XLA_FLAGS is read at backend-init
+time (still ahead of us) and the platform is switchable via
+jax.config.update after import.
 """
 
 import os
 
-# Unconditional: the shell exports JAX_PLATFORMS=axon (real TPU), which would
-# make the suite run single-device on hardware and never create the 8-device
-# mesh. Tests always run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Keep CPU tests deterministic and fast.
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,130 @@
+"""Eval-protocol tests: VOC AP and the in-repo COCO eval on hand-checked cases
+(SURVEY.md §8 'Hard parts' #4 — validate COCO matching on small cases)."""
+
+import numpy as np
+
+from mx_rcnn_tpu.evaluation.coco_eval import COCOEval, bbox_iou_xywh
+from mx_rcnn_tpu.evaluation.voc_eval import eval_class, voc_ap
+
+
+def det(img, x, y, w, h, score, cat=1):
+    return {"image_id": img, "category_id": cat,
+            "bbox": [x, y, w, h], "score": score}
+
+
+def gt(img, x, y, w, h, cat=1, crowd=0, ann_id=0):
+    return {"id": ann_id, "image_id": img, "category_id": cat,
+            "bbox": [x, y, w, h], "area": w * h, "iscrowd": crowd}
+
+
+def make_dataset(gts, num_images=2, cats=(1,)):
+    return {
+        "images": [{"id": i, "width": 640, "height": 480}
+                   for i in range(num_images)],
+        "categories": [{"id": c, "name": f"c{c}"} for c in cats],
+        "annotations": gts,
+    }
+
+
+class TestVocAp:
+    def test_perfect_detection(self):
+        gt_by_image = {0: np.array([[10, 10, 50, 50]], float)}
+        det_by_image = {0: np.array([[10, 10, 50, 50, 0.9]], float)}
+        assert eval_class(gt_by_image, det_by_image) == 1.0
+
+    def test_miss_halves_recall(self):
+        gt_by_image = {0: np.array([[10, 10, 50, 50], [100, 100, 150, 150]],
+                                   float)}
+        det_by_image = {0: np.array([[10, 10, 50, 50, 0.9]], float)}
+        ap = eval_class(gt_by_image, det_by_image)
+        assert abs(ap - 0.5) < 1e-6
+
+    def test_duplicate_is_fp(self):
+        gt_by_image = {0: np.array([[10, 10, 50, 50]], float)}
+        det_by_image = {0: np.array([[10, 10, 50, 50, 0.9],
+                                     [11, 11, 51, 51, 0.8]], float)}
+        # AP unchanged (dup ranks below the TP) but precision tail dips.
+        ap = eval_class(gt_by_image, det_by_image)
+        assert abs(ap - 1.0) < 1e-6
+
+    def test_difficult_excluded(self):
+        gt_by_image = {0: np.array([[10, 10, 50, 50], [100, 100, 150, 150]],
+                                   float)}
+        diff = {0: np.array([False, True])}
+        det_by_image = {0: np.array([[10, 10, 50, 50, 0.9]], float)}
+        assert eval_class(gt_by_image, det_by_image, diff) == 1.0
+
+    def test_07_metric_differs(self):
+        rec = np.array([0.5])
+        prec = np.array([1.0])
+        assert abs(voc_ap(rec, prec, use_07_metric=True) - 6 / 11) < 1e-6
+        assert abs(voc_ap(rec, prec, use_07_metric=False) - 0.5) < 1e-6
+
+
+class TestCocoEval:
+    def test_iou_xywh(self):
+        d = np.array([[0, 0, 10, 10]], float)
+        g = np.array([[0, 0, 10, 10], [5, 0, 10, 10]], float)
+        iou = bbox_iou_xywh(d, g, np.array([False, False]))
+        assert abs(iou[0, 0] - 1.0) < 1e-9
+        assert abs(iou[0, 1] - 50 / 150) < 1e-9
+
+    def test_crowd_iou_is_iof(self):
+        d = np.array([[0, 0, 10, 10]], float)
+        g = np.array([[0, 0, 100, 100]], float)
+        iou = bbox_iou_xywh(d, g, np.array([True]))
+        assert abs(iou[0, 0] - 1.0) < 1e-9  # det fully inside crowd
+
+    def test_perfect_single(self):
+        gts = [gt(0, 10, 10, 40, 40, ann_id=1)]
+        dets = [det(0, 10, 10, 40, 40, 0.9)]
+        stats = COCOEval(make_dataset(gts), dets).summarize()
+        assert abs(stats["AP"] - 1.0) < 1e-6
+        assert abs(stats["AP50"] - 1.0) < 1e-6
+
+    def test_loose_box_fails_high_ious(self):
+        # IoU ≈ 0.6 box: TP at thresholds ≤0.6, FP above.
+        gts = [gt(0, 0, 0, 100, 100, ann_id=1)]
+        dets = [det(0, 0, 0, 80, 100, 0.9)]  # IoU = 0.8
+        stats = COCOEval(make_dataset(gts), dets).summarize()
+        # AP = mean over thresholds: 1.0 for thr <= 0.8 (7 of 10), 0 above.
+        assert abs(stats["AP"] - 0.7) < 1e-6
+        assert abs(stats["AP50"] - 1.0) < 1e-6
+        assert abs(stats["AP75"] - 1.0) < 1e-6
+
+    def test_crowd_match_not_fp(self):
+        # A det matching only a crowd region is ignored, not an FP; the
+        # other det is a clean TP -> AP stays 1.
+        gts = [gt(0, 10, 10, 40, 40, ann_id=1),
+               gt(0, 200, 200, 100, 100, crowd=1, ann_id=2)]
+        dets = [det(0, 10, 10, 40, 40, 0.9),
+                det(0, 210, 210, 50, 50, 0.8)]
+        stats = COCOEval(make_dataset(gts), dets).summarize()
+        assert abs(stats["AP"] - 1.0) < 1e-6
+
+    def test_unmatched_det_is_fp(self):
+        gts = [gt(0, 10, 10, 40, 40, ann_id=1)]
+        dets = [det(0, 10, 10, 40, 40, 0.9),
+                det(0, 300, 300, 40, 40, 0.95)]  # higher-ranked FP
+        stats = COCOEval(make_dataset(gts), dets).summarize()
+        # Precision at recall>0 is 0.5 everywhere after the FP outranks the TP.
+        assert stats["AP"] < 0.6
+
+    def test_area_ranges(self):
+        gts = [gt(0, 0, 0, 10, 10, ann_id=1),       # small (100 px²)
+               gt(0, 100, 100, 200, 200, ann_id=2)]  # large
+        dets = [det(0, 0, 0, 10, 10, 0.9),
+                det(0, 100, 100, 200, 200, 0.8)]
+        stats = COCOEval(make_dataset(gts), dets).summarize()
+        assert abs(stats["APs"] - 1.0) < 1e-6
+        assert abs(stats["APl"] - 1.0) < 1e-6
+        assert stats["APm"] == -1.0  # no medium gt
+
+    def test_maxdets_cap(self):
+        gts = [gt(0, i * 30, 0, 20, 20, ann_id=i) for i in range(5)]
+        dets = [det(0, i * 30, 0, 20, 20, 0.5 + 0.01 * i) for i in range(5)]
+        ev = COCOEval(make_dataset(gts), dets, max_dets=(1, 10, 100))
+        ev.accumulate()
+        ap_1 = ev._ap(max_det=1)
+        ap_100 = ev._ap(max_det=100)
+        assert ap_100 > ap_1  # capping to 1 det loses recall

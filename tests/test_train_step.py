@@ -1,0 +1,157 @@
+"""Train-step integration: full fwd+bwd+update on an 8-device CPU mesh.
+
+The DP analog of the reference's multi-GPU path (MutableModule over a context
+list + KVStore 'device' allreduce) — SURVEY.md §5 says test it on
+host-simulated devices.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models.faster_rcnn import build_model, forward_train, init_params
+from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
+from mx_rcnn_tpu.train.optimizer import build_optimizer, trainable_mask
+from mx_rcnn_tpu.train.step import create_train_state, make_train_step
+
+PAD = 128
+
+
+def tiny_cfg(batch_images=1):
+    return generate_config(
+        "resnet50", "synthetic",
+        **{
+            "train.rpn_pre_nms_top_n": 256,
+            "train.rpn_post_nms_top_n": 64,
+            "train.batch_rois": 32,
+            "train.max_gt_boxes": 8,
+            "train.batch_images": batch_images,
+            # Small anchors so some are inside the tiny test image.
+            "network.anchor_scales": (2, 4, 8),
+            "image.pad_shape": (PAD, PAD),
+        },
+    )
+
+
+def tiny_batch(b):
+    rs = np.random.RandomState(3)
+    gt = np.zeros((b, 8, 4), np.float32)
+    gt[:, 0] = [10, 10, 70, 60]
+    gt[:, 1] = [50, 40, 110, 100]
+    valid = np.zeros((b, 8), bool)
+    valid[:, :2] = True
+    classes = np.zeros((b, 8), np.int32)
+    classes[:, :2] = [1, 3]
+    return {
+        "image": jnp.asarray(rs.randn(b, PAD, PAD, 3).astype(np.float32)),
+        "im_info": jnp.asarray([[PAD, PAD, 1.0]] * b, np.float32),
+        "gt_boxes": jnp.asarray(gt),
+        "gt_classes": jnp.asarray(classes),
+        "gt_valid": jnp.asarray(valid),
+    }
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    return cfg, model, params
+
+
+def test_forward_train_losses_finite_and_nonzero(setup):
+    cfg, model, params = setup
+    loss, aux = jax.jit(
+        lambda p, b, k: forward_train(model, p, b, k, cfg)
+    )(params, tiny_batch(1), jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
+    # With small anchors the RPN must see positives and negatives.
+    assert float(aux["rpn_cls_loss"]) > 0
+    assert float(aux["rcnn_cls_loss"]) > 0
+
+
+def test_train_step_updates_trainable_only(setup):
+    cfg, model, params = setup
+    tx = build_optimizer(cfg, params, steps_per_epoch=100)
+    state = create_train_state(params, tx)
+    step_fn = make_train_step(model, cfg, mesh=None, donate=False)
+    new_state, metrics = step_fn(state, tiny_batch(1), jax.random.PRNGKey(2))
+    assert np.isfinite(float(metrics["TotalLoss"]))
+
+    mask = trainable_mask(params, cfg.network.fixed_param_patterns)
+    flat_old = jax.tree_util.tree_leaves_with_path(params)
+    flat_new = dict(jax.tree_util.tree_leaves_with_path(new_state.params))
+    flat_mask = dict(jax.tree_util.tree_leaves_with_path(mask))
+    changed_any = False
+    for path, old in flat_old:
+        new = flat_new[path]
+        trainable = flat_mask[path]
+        if not trainable:
+            np.testing.assert_array_equal(
+                np.asarray(old), np.asarray(new),
+                err_msg=f"frozen param changed: {path}")
+        elif not np.allclose(np.asarray(old), np.asarray(new)):
+            changed_any = True
+    assert changed_any, "no trainable parameter changed"
+
+
+def test_frozen_mask_covers_reference_prefixes(setup):
+    cfg, model, params = setup
+    mask = trainable_mask(params, cfg.network.fixed_param_patterns)
+    flat = jax.tree_util.tree_leaves_with_path(mask)
+
+    def joined(path):
+        return "/".join(str(getattr(p, "key", p)) for p in path)
+
+    for path, trainable in flat:
+        j = joined(path)
+        if "conv0" in j or "stage1" in j or "bn0" in j:
+            assert not trainable, f"{j} should be frozen"
+        if j.endswith("gamma") or j.endswith("beta"):
+            assert not trainable, f"{j} (BN affine) should be frozen"
+        if "rpn" in j or "cls_score" in j or "bbox_pred" in j:
+            assert trainable, f"{j} should be trainable"
+
+
+def test_multichip_dp_step_runs():
+    """8-device CPU mesh: batch sharded, grads allreduced, one step."""
+    assert jax.device_count() >= 8, "conftest must force 8 CPU devices"
+    cfg = tiny_cfg(batch_images=8)
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    mesh = create_mesh("8")
+    tx = build_optimizer(cfg, params, steps_per_epoch=100)
+    state = create_train_state(params, tx)
+    step_fn = make_train_step(model, cfg, mesh=mesh, donate=False)
+    batch = shard_batch(tiny_batch(8), mesh)
+    new_state, metrics = step_fn(state, batch, jax.random.PRNGKey(2))
+    assert np.isfinite(float(metrics["TotalLoss"]))
+
+
+def test_dp_grads_match_single_device():
+    """DP over 2 virtual devices == single device on the same 2-image batch
+    (the KVStore-allreduce correctness check the reference never had)."""
+    cfg = tiny_cfg(batch_images=2)
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    batch = tiny_batch(2)
+    rng = jax.random.PRNGKey(5)
+
+    tx = build_optimizer(cfg, params, steps_per_epoch=100)
+    s1 = create_train_state(params, tx)
+    single = make_train_step(model, cfg, mesh=None, donate=False)
+    s1_new, m1 = single(s1, batch, rng)
+
+    mesh = create_mesh("2")
+    s2 = create_train_state(params, tx)
+    dp = make_train_step(model, cfg, mesh=mesh, donate=False)
+    s2_new, m2 = dp(s2, shard_batch(batch, mesh), rng)
+
+    assert np.allclose(float(m1["TotalLoss"]), float(m2["TotalLoss"]), rtol=1e-4)
+    l1 = jax.tree.leaves(s1_new.params)
+    l2 = jax.tree.leaves(s2_new.params)
+    for a, b in zip(l1, l2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-5)

@@ -1,0 +1,96 @@
+"""4-stage alternate optimization (Ren et al. 2015).
+
+Reference entry point: train_alternate.py (SURVEY.md §4.4):
+  1. train RPN (from pretrained trunk)
+  2. dump stage-1 proposals
+  3. train Fast R-CNN on them (fresh trunk)
+  4. train RPN again, trunk frozen from stage 3
+  5. dump stage-2 proposals
+  6. train Fast R-CNN, trunk frozen
+  7. combine RPN(4) + RCNN(6) → final checkpoint
+
+    python train_alternate.py --network vgg --dataset PascalVOC \
+        --image_set 2007_trainval --prefix model/alt
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.tools.stages import (
+    test_rpn_generate,
+    train_rcnn,
+    train_rpn,
+)
+from mx_rcnn_tpu.train.checkpoint import save_checkpoint
+from mx_rcnn_tpu.utils.combine_model import combine_model
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="Alternate-optimization training")
+    p.add_argument("--network", default="vgg")
+    p.add_argument("--dataset", default="PascalVOC")
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--prefix", default="model/alt")
+    p.add_argument("--rpn_epoch", type=int, default=8)
+    p.add_argument("--rcnn_epoch", type=int, default=8)
+    p.add_argument("--frequent", type=int, default=20)
+    p.add_argument("--tpu-mesh", "--gpus", dest="tpu_mesh", default="")
+    return p.parse_args()
+
+
+def alternate_train(cfg, prefix, rpn_epoch, rcnn_epoch, mesh_spec="",
+                    frequent=20):
+    os.makedirs(prefix, exist_ok=True)
+    logger.info("=== stage 1: train RPN ===")
+    rpn1 = train_rpn(cfg, f"{prefix}_rpn1", end_epoch=rpn_epoch,
+                     mesh_spec=mesh_spec, frequent=frequent)
+    logger.info("=== stage 2: generate stage-1 proposals ===")
+    test_rpn_generate(cfg, rpn1, f"{prefix}_rpn1_proposals.pkl")
+    logger.info("=== stage 3: train Fast R-CNN ===")
+    rcnn1 = train_rcnn(cfg, f"{prefix}_rcnn1", f"{prefix}_rpn1_proposals.pkl",
+                       end_epoch=rcnn_epoch, mesh_spec=mesh_spec,
+                       frequent=frequent)
+    logger.info("=== stage 4: re-train RPN, trunk frozen ===")
+    rpn2 = train_rpn(cfg, f"{prefix}_rpn2", pretrained_params=rcnn1,
+                     end_epoch=rpn_epoch, frozen_trunk=True,
+                     mesh_spec=mesh_spec, frequent=frequent)
+    logger.info("=== stage 5: generate stage-2 proposals ===")
+    test_rpn_generate(cfg, rpn2, f"{prefix}_rpn2_proposals.pkl")
+    logger.info("=== stage 6: re-train Fast R-CNN, trunk frozen ===")
+    rcnn2 = train_rcnn(cfg, f"{prefix}_rcnn2", f"{prefix}_rpn2_proposals.pkl",
+                       pretrained_params=rpn2, end_epoch=rcnn_epoch,
+                       frozen_trunk=True, mesh_spec=mesh_spec,
+                       frequent=frequent)
+    logger.info("=== stage 7: combine ===")
+    final = combine_model(rpn2, rcnn2)
+    save_checkpoint(prefix, 0, final,
+                    means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+                    num_classes=cfg.dataset.num_classes)
+    logger.info("alternate training complete: %s", prefix)
+    return final
+
+
+def main():
+    args = parse_args()
+    overrides = {}
+    if args.image_set:
+        overrides["dataset.image_set"] = args.image_set
+    if args.root_path:
+        overrides["dataset.root_path"] = args.root_path
+    if args.dataset_path:
+        overrides["dataset.dataset_path"] = args.dataset_path
+    cfg = generate_config(args.network, args.dataset, **overrides)
+    alternate_train(cfg, args.prefix, args.rpn_epoch, args.rcnn_epoch,
+                    mesh_spec=args.tpu_mesh, frequent=args.frequent)
+
+
+if __name__ == "__main__":
+    main()

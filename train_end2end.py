@@ -1,0 +1,94 @@
+"""Train Faster R-CNN end-to-end (approximate joint optimization).
+
+Reference entry point: train_end2end.py (flags preserved per the north star;
+``--gpus`` → ``--tpu-mesh``, ``--kvstore`` kept as a no-op alias since the
+mesh IS the comm backend). Example:
+
+    python train_end2end.py --network resnet101 --dataset coco \
+        --image_set train2017 --tpu-mesh 8 --prefix model/e2e
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.tools.train import fit_detector, load_gt_roidbs
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="Train Faster R-CNN end-to-end")
+    p.add_argument("--network", default="resnet101",
+                   help="vgg | resnet50 | resnet101 | *_fpn | *_fpn_mask")
+    p.add_argument("--dataset", default="coco",
+                   help="PascalVOC | coco | synthetic")
+    p.add_argument("--image_set", default=None,
+                   help="e.g. 2007_trainval or train2017; '+' merges sets")
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--frequent", type=int, default=20, help="logging interval")
+    p.add_argument("--kvstore", default="device",
+                   help="no-op alias (comm backend is the TPU mesh)")
+    p.add_argument("--work_load_list", default=None, help="no-op alias")
+    p.add_argument("--no_flip", action="store_true")
+    p.add_argument("--no_shuffle", action="store_true")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--pretrained", default=None,
+                   help="orbax checkpoint prefix to initialize from")
+    p.add_argument("--pretrained_epoch", type=int, default=0)
+    p.add_argument("--prefix", default="model/e2e", help="checkpoint prefix")
+    p.add_argument("--begin_epoch", type=int, default=0)
+    p.add_argument("--end_epoch", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr_step", default=None, help="e.g. '7' or '5,7'")
+    p.add_argument("--tpu-mesh", "--gpus", dest="tpu_mesh", default="",
+                   help="mesh shape: '8' or '4x2' (replaces --gpus)")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    overrides = {}
+    if args.image_set:
+        overrides["dataset.image_set"] = args.image_set
+    if args.root_path:
+        overrides["dataset.root_path"] = args.root_path
+    if args.dataset_path:
+        overrides["dataset.dataset_path"] = args.dataset_path
+    if args.no_flip:
+        overrides["train.flip"] = False
+    if args.no_shuffle:
+        overrides["train.shuffle"] = False
+    if args.lr is not None:
+        overrides["train.lr"] = args.lr
+    if args.lr_step:
+        overrides["train.lr_step"] = tuple(
+            int(s) for s in args.lr_step.split(","))
+    if args.end_epoch:
+        overrides["train.end_epoch"] = args.end_epoch
+    cfg = generate_config(args.network, args.dataset, **overrides)
+    logger.info("config: network=%s dataset=%s", args.network, args.dataset)
+
+    pretrained = None
+    if args.pretrained:
+        from mx_rcnn_tpu.train.checkpoint import load_checkpoint
+        pretrained, _ = load_checkpoint(
+            args.pretrained, args.pretrained_epoch,
+            means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+            num_classes=cfg.dataset.num_classes)
+
+    roidb = load_gt_roidbs(cfg)
+    fit_detector(
+        cfg, roidb, args.prefix,
+        begin_epoch=args.begin_epoch,
+        end_epoch=args.end_epoch,
+        frequent=args.frequent,
+        resume=args.resume,
+        pretrained_params=pretrained,
+        mesh_spec=args.tpu_mesh,
+    )
+
+
+if __name__ == "__main__":
+    main()
