@@ -14,7 +14,8 @@ from its published definition:
 - greedy matching in score order; each non-ignore gt matches at most once;
   an already-found non-ignore match is never displaced by an ignore one.
 
-Validated against hand-checked small cases in tests/test_coco_eval.py.
+Validated against hand-checked small cases and a scalar reference matcher
+in tests/test_eval.py.
 """
 
 from __future__ import annotations
@@ -96,24 +97,28 @@ class COCOEval:
         dt_match = np.zeros((T, D), bool)
         dt_ignore = np.zeros((T, D), bool)
         gt_match = np.zeros((T, G), bool)
-        for t, thr in enumerate(IOU_THRS):
+        # Greedy matching, vectorized over the T and G axes (the det loop is
+        # inherently sequential: each match consumes a gt). Per det, per
+        # threshold: among still-available gts with IoU ≥ thr, prefer
+        # non-ignore gts; pick the max IoU, ties going to the LAST gt in
+        # sorted order (the sequential scan updates on `>=`).
+        if D and G:
+            thr_init = np.minimum(IOU_THRS, 1 - 1e-10)[:, None]  # (T, 1)
             for di in range(D):
-                best_iou = min(thr, 1 - 1e-10)
-                m = -1
-                for gi in range(G):
-                    if gt_match[t, gi] and not iscrowd[gi]:
-                        continue
-                    if m > -1 and not gt_ignore[m] and gt_ignore[gi]:
-                        break  # ignores are sorted last; keep the real match
-                    if ious[di, gi] < best_iou:
-                        continue
-                    best_iou = ious[di, gi]
-                    m = gi
-                if m == -1:
-                    continue
-                dt_match[t, di] = True
-                dt_ignore[t, di] = gt_ignore[m]
-                gt_match[t, m] = True
+                iou_d = ious[di][None, :]  # (1, G)
+                avail = ~(gt_match & ~iscrowd[None, :])
+                cand = avail & (iou_d >= thr_init)  # (T, G)
+                cand_ni = cand & ~gt_ignore[None, :]
+                sel = np.where(cand_ni.any(axis=1)[:, None],
+                               cand_ni, cand & gt_ignore[None, :])
+                has = sel.any(axis=1)
+                masked = np.where(sel, iou_d, -np.inf)
+                m = G - 1 - np.argmax(masked[:, ::-1], axis=1)  # last-tie argmax
+                t_idx = np.nonzero(has)[0]
+                mm = m[t_idx]
+                dt_match[t_idx, di] = True
+                dt_ignore[t_idx, di] = gt_ignore[mm]
+                gt_match[t_idx, mm] = True
         # Detections outside the area range and unmatched → ignored.
         d_areas = d_boxes[:, 2] * d_boxes[:, 3]
         d_out = (d_areas < area_rng[0]) | (d_areas >= area_rng[1])

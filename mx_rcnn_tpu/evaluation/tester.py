@@ -149,7 +149,9 @@ def _vis_batch(batch, metas, per_image, imdb, test_loader, vis_dir):
             continue
         dets = per_image[i]
         dets = dets[dets[:, 1] >= 0.5].copy()
-        dets[:, 2:6] *= meta["scale"]  # back to network-input coords
+        # im_detect divided every image's boxes by metas[0]["scale"]; undo
+        # exactly that to return to network-input coords.
+        dets[:, 2:6] *= metas[0]["scale"]
         img = transform_inverse(batch["image"][i], cfg.image.pixel_means,
                                 cfg.image.pixel_stds)
         save_vis(img, dets, class_names,

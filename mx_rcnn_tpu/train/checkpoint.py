@@ -108,7 +108,14 @@ def load_checkpoint(prefix: str, epoch: int, *, template=None,
                 else template}
         if opt_state_template is not None and _has_opt_state(path):
             item["opt_state"] = opt_state_template
-    restored = ckptr.restore(path, item=item)
+    if item is not None and "opt_state" not in item and _has_opt_state(path):
+        # Inference-time load of a training checkpoint: restore params only,
+        # skipping the saved opt_state (orbax rejects the structure mismatch
+        # otherwise).
+        restored = ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(item=item, partial_restore=True))
+    else:
+        restored = ckptr.restore(path, item=item)
     params = restored["params"]
     if num_classes is not None:
         params = renormalize_bbox_params(params, means, stds, num_classes)
@@ -120,7 +127,11 @@ def load_checkpoint(prefix: str, epoch: int, *, template=None,
 
 def _has_opt_state(path: str) -> bool:
     try:
-        return "opt_state" in ocp.PyTreeCheckpointer().metadata(path).tree
+        meta = ocp.PyTreeCheckpointer().metadata(path)
+        # orbax >= 0.5: StepMetadata with .item_metadata mapping; older
+        # versions return the tree mapping directly.
+        tree = getattr(meta, "item_metadata", meta)
+        return "opt_state" in tree
     except Exception:
         return os.path.isdir(os.path.join(path, "opt_state"))
 

@@ -128,3 +128,78 @@ class TestCocoEval:
         ap_1 = ev._ap(max_det=1)
         ap_100 = ev._ap(max_det=100)
         assert ap_100 > ap_1  # capping to 1 det loses recall
+
+
+class TestMatcherDifferential:
+    """The vectorized greedy matcher must reproduce the scalar pycocotools
+    scan exactly (per-threshold availability, non-ignore preference,
+    >= tie update → last-tie argmax)."""
+
+    @staticmethod
+    def _scalar_match(ious, iscrowd, gt_ignore, iou_thrs):
+        T, (D, G) = len(iou_thrs), ious.shape
+        dt_match = np.zeros((T, D), bool)
+        dt_ignore = np.zeros((T, D), bool)
+        gt_match = np.zeros((T, G), bool)
+        for t, thr in enumerate(iou_thrs):
+            for di in range(D):
+                best_iou = min(thr, 1 - 1e-10)
+                m = -1
+                for gi in range(G):
+                    if gt_match[t, gi] and not iscrowd[gi]:
+                        continue
+                    if m > -1 and not gt_ignore[m] and gt_ignore[gi]:
+                        break
+                    if ious[di, gi] < best_iou:
+                        continue
+                    best_iou = ious[di, gi]
+                    m = gi
+                if m == -1:
+                    continue
+                dt_match[t, di] = True
+                dt_ignore[t, di] = gt_ignore[m]
+                gt_match[t, m] = True
+        return dt_match, dt_ignore
+
+    def test_random_cells_match_scalar(self):
+        rs = np.random.RandomState(7)
+        for trial in range(50):
+            d = rs.randint(0, 12)
+            g = rs.randint(0, 8)
+            gts, dets = [], []
+            for i in range(g):
+                x, y = rs.uniform(0, 80, 2)
+                w, h = rs.uniform(5, 60, 2)
+                gts.append(gt(0, x, y, w, h, crowd=int(rs.rand() < 0.25),
+                              ann_id=i))
+            for _ in range(d):
+                x, y = rs.uniform(0, 80, 2)
+                w, h = rs.uniform(5, 60, 2)
+                dets.append(det(0, x, y, w, h, rs.rand()))
+            # quantize IoUs so exact ties actually occur
+            ev = COCOEval(make_dataset(gts), dets)
+            dts = sorted(dets, key=lambda r: -r["score"])
+            iscrowd = np.array([bool(x["iscrowd"]) for x in gts], bool)
+            gt_areas = [x["area"] for x in gts]
+            gb = np.array([x["bbox"] for x in gts], np.float64).reshape(-1, 4)
+            db = np.array([x["bbox"] for x in dts], np.float64).reshape(-1, 4)
+            ious = (bbox_iou_xywh(db, gb, iscrowd) if d and g
+                    else np.zeros((d, g)))
+            ious = np.round(ious, 1)  # force ties
+            from mx_rcnn_tpu.evaluation.coco_eval import AREA_RANGES, IOU_THRS
+            for rng in AREA_RANGES.values():
+                res = ev._evaluate_img(gts, gt_areas, iscrowd, dts, ious, rng)
+                # rebuild the sorted-order inputs _evaluate_img used
+                gt_ign = np.array([
+                    bool(x.get("iscrowd", 0))
+                    or not (rng[0] <= a < rng[1])
+                    for x, a in zip(gts, gt_areas)], bool)
+                order = np.argsort(gt_ign, kind="stable")
+                sm, si = self._scalar_match(
+                    ious[:, order] if ious.size else ious,
+                    iscrowd[order], gt_ign[order], IOU_THRS)
+                d_areas = db[:, 2] * db[:, 3]
+                d_out = (d_areas < rng[0]) | (d_areas >= rng[1])
+                si = si | (~sm & d_out[None, :])
+                np.testing.assert_array_equal(res["dt_match"], sm)
+                np.testing.assert_array_equal(res["dt_ignore"], si)
