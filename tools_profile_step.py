@@ -1,0 +1,82 @@
+"""Ad-hoc: time each stage of the train step on the real chip."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models.faster_rcnn import (
+    FasterRCNN, _assign_anchors_batch, _backbone_rpn, _pool_rois, _rpn_softmax,
+    build_model, forward_train, init_params)
+from mx_rcnn_tpu.ops.anchors import anchor_grid
+from mx_rcnn_tpu.ops.proposal import generate_proposals
+from mx_rcnn_tpu.targets.rcnn_targets import sample_rois
+from functools import partial
+
+cfg = generate_config("resnet101", "coco",
+                      **{"image.pad_shape": (640, 1024), "train.batch_images": 1})
+b, (h, w), g = 1, cfg.image.pad_shape, cfg.train.max_gt_boxes
+rs = np.random.RandomState(0)
+boxes = np.zeros((b, g, 4), np.float32)
+boxes[:, :8] = np.stack([
+    rs.uniform(0, w - 200, (b, 8)), rs.uniform(0, h - 200, (b, 8)),
+    rs.uniform(200, 400, (b, 8)), rs.uniform(200, 400, (b, 8))], axis=-1)
+valid = np.zeros((b, g), bool); valid[:, :8] = True
+classes = np.zeros((b, g), np.int32); classes[:, :8] = 5
+batch = {
+    "image": jnp.asarray(rs.randn(b, h, w, 3).astype(np.float32)),
+    "im_info": jnp.asarray([[600, 1000, 1.0]] * b, np.float32),
+    "gt_boxes": jnp.asarray(boxes), "gt_classes": jnp.asarray(classes),
+    "gt_valid": jnp.asarray(valid),
+}
+model = build_model(cfg)
+params = init_params(model, cfg, jax.random.PRNGKey(0))
+rng = jax.random.PRNGKey(1)
+
+
+def timeit(name, fn, *args, n=5):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    print(f"{name:30s} {(time.perf_counter() - t0) / n * 1e3:9.1f} ms")
+    return out
+
+
+feat_fn = jax.jit(lambda p, im: _backbone_rpn(model, p, im, cfg))
+feat, cls_l, box_d, anchors = timeit("backbone+rpn fwd", feat_fn, params, batch["image"])
+
+assign_fn = jax.jit(lambda a, bt, r: _assign_anchors_batch(a, bt, r, cfg))
+timeit("assign_anchor", assign_fn, anchors, batch, rng)
+
+prop_fn = jax.jit(lambda cl, bd, ii: generate_proposals(
+    _rpn_softmax(cl, model.num_anchors), bd, ii, anchors,
+    pre_nms_top_n=cfg.train.rpn_pre_nms_top_n,
+    post_nms_top_n=cfg.train.rpn_post_nms_top_n,
+    nms_thresh=cfg.train.rpn_nms_thresh,
+    min_size=cfg.train.rpn_min_size, feat_stride=16))
+rois, roi_valid, _ = timeit("generate_proposals(train)", prop_fn, cls_l, box_d, batch["im_info"])
+
+samp_fn = jax.jit(lambda r, v, bt, k: jax.vmap(partial(
+    sample_rois, num_classes=model.num_classes, batch_rois=cfg.train.batch_rois,
+    fg_fraction=cfg.train.fg_fraction, fg_thresh=cfg.train.fg_thresh,
+    bg_thresh_hi=cfg.train.bg_thresh_hi, bg_thresh_lo=cfg.train.bg_thresh_lo,
+    bbox_means=cfg.train.bbox_means, bbox_stds=cfg.train.bbox_stds))(
+    r, v, bt["gt_boxes"], bt["gt_classes"], bt["gt_valid"],
+    jax.random.split(k, r.shape[0])))
+samples = timeit("sample_rois", samp_fn, rois, roi_valid, batch, rng)
+
+pool_fn = jax.jit(lambda f, r, v: _pool_rois(f, r, v, model.roi_pool_size,
+                                             model.roi_pool_type))
+pooled = timeit("roi_align", pool_fn, feat, samples.rois, samples.valid)
+
+head_fn = jax.jit(lambda p, x: model.apply(p, x, True, method=FasterRCNN.box_head))
+timeit("box_head fwd", head_fn, params, pooled)
+
+fwd = jax.jit(lambda p, bt, r: forward_train(model, p, bt, r, cfg)[0])
+timeit("full fwd", fwd, params, batch, rng, n=3)
+
+grad = jax.jit(jax.grad(lambda p, bt, r: forward_train(model, p, bt, r, cfg)[0]))
+timeit("full fwd+bwd", grad, params, batch, rng, n=3)

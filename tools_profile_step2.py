@@ -1,0 +1,36 @@
+import time
+import jax, jax.numpy as jnp, numpy as np
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models.faster_rcnn import build_model, init_params
+from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
+from mx_rcnn_tpu.train.optimizer import build_optimizer
+from mx_rcnn_tpu.train.step import create_train_state, make_train_step
+
+cfg = generate_config("resnet101", "coco",
+                      **{"image.pad_shape": (640, 1024), "train.batch_images": 1})
+b, (h, w), g = 1, cfg.image.pad_shape, cfg.train.max_gt_boxes
+rs = np.random.RandomState(0)
+boxes = np.zeros((b, g, 4), np.float32); boxes[:, :8] = [100, 100, 300, 300]
+valid = np.zeros((b, g), bool); valid[:, :8] = True
+classes = np.zeros((b, g), np.int32); classes[:, :8] = 5
+batch = {"image": rs.randn(b, h, w, 3).astype(np.float32),
+         "im_info": np.asarray([[600, 1000, 1.0]] * b, np.float32),
+         "gt_boxes": boxes, "gt_classes": classes, "gt_valid": valid}
+model = build_model(cfg)
+params = init_params(model, cfg, jax.random.PRNGKey(0))
+tx = build_optimizer(cfg, params, steps_per_epoch=1000)
+state = create_train_state(params, tx)
+mesh = create_mesh(str(jax.device_count()))
+step_fn = make_train_step(model, cfg, mesh=mesh)
+batch = shard_batch(batch, mesh)
+rng = jax.random.PRNGKey(1)
+t0 = time.perf_counter()
+state, metrics = step_fn(state, batch, rng)
+jax.block_until_ready(metrics["TotalLoss"])
+print(f"compile+first step: {time.perf_counter()-t0:.1f}s")
+for it in range(5):
+    rng, k = jax.random.split(rng)
+    t0 = time.perf_counter()
+    state, metrics = step_fn(state, batch, k)
+    jax.block_until_ready(metrics["TotalLoss"])
+    print(f"step {it}: {(time.perf_counter()-t0)*1e3:.0f} ms")
