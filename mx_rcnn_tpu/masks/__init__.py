@@ -1,0 +1,25 @@
+"""Mask toolkit: RLE codec + rasterization (pycocotools mask API analog).
+
+Reference: rcnn/pycocotools/mask.py public surface (encode/decode/merge/
+iou/area/frPyObjects/toBbox) over the C maskApi (rcnn/pycocotools/maskApi.c).
+"""
+
+from mx_rcnn_tpu.masks.rle import (
+    area,
+    compress,
+    decode,
+    decompress,
+    encode,
+    fr_bbox,
+    fr_poly,
+    fr_py_objects,
+    iou,
+    merge,
+    poly_to_mask,
+    to_bbox,
+)
+
+__all__ = [
+    "area", "compress", "decode", "decompress", "encode", "fr_bbox",
+    "fr_poly", "fr_py_objects", "iou", "merge", "poly_to_mask", "to_bbox",
+]

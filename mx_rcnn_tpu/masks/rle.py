@@ -1,0 +1,276 @@
+"""RLE mask API — the pycocotools mask toolkit, rebuilt.
+
+Reference: rcnn/pycocotools/ (`mask.py`, `_mask.pyx`, `maskApi.c/.h`) — the
+vendored C run-length-encoding kernels COCO evaluation depends on
+(encode/decode/merge/iou/area, polygon + bbox rasterization, and the
+compressed-string codec used by COCO json `segmentation` fields). This
+environment has no pycocotools, so the API is re-provided here: an exact
+numpy implementation (this module) with an optional C fast path
+(mx_rcnn_tpu/masks/_native.py wrapping cc/maskapi.c via ctypes) for the
+dense-mask hot calls. Host-side, eval-only code — nothing here traces.
+
+RLE format (identical to pycocotools):
+  a binary (H, W) mask is read in COLUMN-major (Fortran) order; `counts`
+  holds alternating run lengths, starting with the count of 0s (possibly 0).
+  `{"size": [h, w], "counts": [...]}` is the uncompressed dict form;
+  `{"size": [h, w], "counts": b"..."}` is the compressed form using the
+  COCO varint/delta string codec (see `compress`).
+
+Design deltas vs the reference, documented per SURVEY.md §3.1 item 5:
+  - polygon rasterization uses a standard even-odd scanline fill at pixel
+    centers rather than maskApi's 5x-upsampled boundary walk; boundary
+    pixels can differ by ±1 on polygon edges (irrelevant to the eval
+    protocol, which is validated against hand-computed cases).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import numpy as np
+
+RLE = dict  # {"size": [h, w], "counts": list[int] | bytes}
+
+
+# ---------------------------------------------------------------------------
+# Core encode / decode
+# ---------------------------------------------------------------------------
+
+
+def encode(mask: np.ndarray) -> RLE:
+    """Binary (H, W) mask -> compressed RLE.
+
+    Matches pycocotools.mask.encode for a single mask (pass masks
+    individually; the (H, W, N) batched form is a thin loop away).
+    """
+    h, w = mask.shape
+    flat = np.asfortranarray(mask.astype(bool)).ravel(order="F")
+    return {"size": [int(h), int(w)], "counts": compress(_runs(flat))}
+
+
+def decode(rle: RLE) -> np.ndarray:
+    """RLE (compressed or not) -> binary (H, W) uint8 mask."""
+    h, w = rle["size"]
+    counts = _counts(rle)
+    total = int(sum(counts))
+    if total != h * w:
+        raise ValueError(f"RLE length {total} != h*w {h * w}")
+    flat = np.zeros(h * w, np.uint8)
+    pos = 0
+    val = 0
+    for c in counts:
+        if val:
+            flat[pos:pos + c] = 1
+        pos += c
+        val ^= 1
+    return flat.reshape(w, h).T  # column-major -> (H, W)
+
+
+def _runs(flat: np.ndarray) -> List[int]:
+    """Run lengths of a flat boolean array, starting with the 0-run."""
+    n = flat.shape[0]
+    if n == 0:
+        return []
+    change = np.flatnonzero(flat[1:] != flat[:-1]) + 1
+    bounds = np.concatenate([[0], change, [n]])
+    runs = np.diff(bounds).tolist()
+    if flat[0]:  # counts must start with a (possibly empty) 0-run
+        runs = [0] + runs
+    return [int(r) for r in runs]
+
+
+def _counts(rle: RLE) -> List[int]:
+    c = rle["counts"]
+    if isinstance(c, (bytes, str)):
+        return decompress(c)
+    return list(c)
+
+
+# ---------------------------------------------------------------------------
+# Compressed-string codec (COCO json `counts` strings)
+# ---------------------------------------------------------------------------
+#
+# Each count is a signed varint in base-32 "6-bit char" encoding (chars
+# offset from 48), more significant groups later, with bit 5 of each char as
+# the continuation flag; counts at index > 2 store the DELTA to counts[i-2]
+# (maskApi rleToString: the first three counts are stored raw).
+
+
+def compress(counts: Sequence[int]) -> bytes:
+    out = bytearray()
+    for i, c in enumerate(counts):
+        x = int(c)
+        if i > 2:
+            x -= int(counts[i - 2])
+        more = True
+        while more:
+            chunk = x & 0x1F
+            x >>= 5
+            # Sign-aware termination: stop when remaining bits are pure sign
+            # extension of the chunk's high bit.
+            more = not (x == -1 and (chunk & 0x10)) and not (
+                x == 0 and not (chunk & 0x10))
+            if more:
+                chunk |= 0x20
+            out.append(chunk + 48)
+    return bytes(out)
+
+
+def decompress(s: Union[bytes, str]) -> List[int]:
+    if isinstance(s, str):
+        s = s.encode("ascii")
+    counts: List[int] = []
+    pos = 0
+    n = len(s)
+    while pos < n:
+        x = 0
+        shift = 0
+        while True:
+            c = s[pos] - 48
+            pos += 1
+            x |= (c & 0x1F) << shift
+            if not (c & 0x20):
+                # Sign-extend from the top bit of the last chunk.
+                if c & 0x10:
+                    x |= -1 << (shift + 5)
+                break
+            shift += 5
+        if len(counts) > 2:
+            x += counts[-2]
+        counts.append(int(x))
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# Derived ops: area / merge / iou / toBbox
+# ---------------------------------------------------------------------------
+
+
+def area(rle: RLE) -> int:
+    counts = _counts(rle)
+    return int(sum(counts[1::2]))
+
+
+def merge(rles: Sequence[RLE], intersect: bool = False) -> RLE:
+    """Union (default) or intersection of masks, all the same size."""
+    if not rles:
+        raise ValueError("merge of empty list")
+    if len(rles) == 1:
+        return {"size": list(rles[0]["size"]), "counts": compress(_counts(rles[0]))}
+    h, w = rles[0]["size"]
+    acc = decode(rles[0]).astype(bool)
+    for r in rles[1:]:
+        if list(r["size"]) != [h, w]:
+            raise ValueError("merge of differently-sized masks")
+        m = decode(r).astype(bool)
+        acc = (acc & m) if intersect else (acc | m)
+    return encode(acc)
+
+
+def iou(dt: Sequence[RLE], gt: Sequence[RLE],
+        iscrowd: Sequence[bool]) -> np.ndarray:
+    """Pairwise mask IoU matrix (len(dt), len(gt)).
+
+    Crowd semantics (maskApi rleIou): for a crowd gt the denominator is the
+    DETECTION's area (i.e. intersection-over-detection), matching the
+    reference's use for ignore regions.
+    """
+    out = np.zeros((len(dt), len(gt)), np.float64)
+    dms = [decode(d).astype(bool) for d in dt]
+    gms = [decode(g).astype(bool) for g in gt]
+    das = [m.sum() for m in dms]
+    gas = [m.sum() for m in gms]
+    for j, gm in enumerate(gms):
+        for i, dm in enumerate(dms):
+            inter = np.logical_and(dm, gm).sum()
+            if iscrowd[j]:
+                denom = das[i]
+            else:
+                denom = das[i] + gas[j] - inter
+            out[i, j] = inter / denom if denom > 0 else 0.0
+    return out
+
+
+def to_bbox(rle: RLE) -> np.ndarray:
+    """RLE -> (x, y, w, h) tight bbox (maskApi rleToBbox)."""
+    m = decode(rle)
+    ys, xs = np.nonzero(m)
+    if ys.size == 0:
+        return np.zeros(4, np.float64)
+    x0, x1 = xs.min(), xs.max()
+    y0, y1 = ys.min(), ys.max()
+    return np.asarray([x0, y0, x1 - x0 + 1, y1 - y0 + 1], np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Rasterization: polygons / bboxes -> RLE (maskApi rleFrPoly / rleFrBbox)
+# ---------------------------------------------------------------------------
+
+
+def poly_to_mask(poly: Sequence[float], h: int, w: int) -> np.ndarray:
+    """Rasterize one polygon [x0, y0, x1, y1, ...] to a (H, W) mask.
+
+    Even-odd scanline fill sampled at pixel centers (x+0.5, y+0.5). See the
+    module docstring for the (boundary-pixel) delta vs maskApi's upsampled
+    boundary walk.
+    """
+    xs = np.asarray(poly[0::2], np.float64)
+    ys = np.asarray(poly[1::2], np.float64)
+    n = xs.shape[0]
+    if n < 3:
+        return np.zeros((h, w), np.uint8)
+    x0 = xs
+    y0 = ys
+    x1 = np.roll(xs, -1)
+    y1 = np.roll(ys, -1)
+    yc = np.arange(h, dtype=np.float64) + 0.5  # scanline centers (H,)
+    # Edge k crosses scanline y iff min < y <= max — half-open, so a vertex
+    # shared by two edges is counted exactly once.
+    ymin = np.minimum(y0, y1)[None, :]
+    ymax = np.maximum(y0, y1)[None, :]
+    crosses = (yc[:, None] > ymin) & (yc[:, None] <= ymax)  # (H, E)
+    dy = y1 - y0
+    safe_dy = np.where(dy == 0, 1.0, dy)
+    t = (yc[:, None] - y0[None, :]) / safe_dy[None, :]
+    xi = x0[None, :] + t * (x1 - x0)[None, :]  # (H, E) crossing x
+    # Non-crossing edges must never count as "to the right" -> -inf.
+    xi = np.where(crosses, xi, -np.inf)
+    xc = np.arange(w, dtype=np.float64) + 0.5  # pixel-center x (W,)
+    # Pixel inside iff an odd number of crossings lie to its right.
+    cnt = (xi[:, None, :] > xc[None, :, None]).sum(axis=2)  # (H, W)
+    return ((cnt % 2) == 1).astype(np.uint8)
+
+
+def fr_poly(polys: Sequence[Sequence[float]], h: int, w: int) -> RLE:
+    """Multi-part polygon -> merged RLE (pycocotools frPyObjects + merge)."""
+    m = np.zeros((h, w), bool)
+    for poly in polys:
+        m |= poly_to_mask(poly, h, w).astype(bool)
+    return encode(m)
+
+
+def fr_bbox(bbox: Sequence[float], h: int, w: int) -> RLE:
+    """(x, y, w, h) box -> RLE (maskApi rleFrBbox: pixel (i,j) is inside iff
+    its center is within the box extent, via integer rounding of edges)."""
+    x, y, bw, bh = bbox
+    m = np.zeros((h, w), np.uint8)
+    x0 = int(np.floor(x + 0.5))
+    y0 = int(np.floor(y + 0.5))
+    x1 = int(np.floor(x + bw + 0.5))
+    y1 = int(np.floor(y + bh + 0.5))
+    m[max(y0, 0):max(y1, 0), max(x0, 0):max(x1, 0)] = 1
+    return encode(m)
+
+
+def fr_py_objects(obj, h: int, w: int) -> RLE:
+    """COCO `segmentation` field (polygon list / RLE dict / uncompressed
+    dict) -> compressed RLE. pycocotools.mask.frPyObjects equivalent for the
+    single-object case."""
+    if isinstance(obj, dict):
+        return {"size": list(obj["size"]), "counts": compress(_counts(obj))}
+    if isinstance(obj, (list, tuple)) and obj and isinstance(
+            obj[0], (list, tuple)):
+        return fr_poly(obj, h, w)
+    if isinstance(obj, (list, tuple)):
+        return fr_poly([obj], h, w)
+    raise TypeError(f"unsupported segmentation object: {type(obj)}")

@@ -17,8 +17,10 @@ restructured for the TPU memory hierarchy instead of 64-thread warps:
   matmul into a persistent (1, N) VMEM accumulator.
 
 Semantics match ops/nms.py exactly (strict `>` threshold, +1 inclusive box
-widths, score-descending greedy order); tests/test_nms.py checks equivalence
-against the jnp oracles on random sets.
+widths, score-descending greedy order). This kernel is the production NMS for
+proposal generation on TPU (ops/proposal.py dispatches to ``batched_nms``
+when the backend is TPU); tests/test_nms.py::TestBatchedNMSPallas checks
+equivalence against both jnp oracles (interpret mode off-TPU).
 
 Mosaic lowering notes: dynamic_slice on computed VALUES is unsupported — all
 dynamic indexing here happens either through BlockSpec index maps (the

@@ -11,6 +11,16 @@ Pipeline (reference semantics, static shapes):
   → min-size filter (mask, not drop) → top pre_nms_top_n by score
   → greedy NMS(thresh) → top post_nms_top_n, padded + validity mask.
 
+NMS dispatch (``nms_impl``):
+  - "pallas": the blocked-bitmask Pallas TPU kernel
+    (ops/nms_pallas.py::batched_nms — the nms_kernel.cu analog), one batched
+    call over all images.
+  - "xla": the jnp formulations (ops/nms.py) — bitmask for small candidate
+    sets, iterative otherwise — vmapped per image.
+  - "auto" (default): "pallas" on the TPU backend, "xla" elsewhere (the
+    Pallas kernel still runs off-TPU via the interpreter, but the XLA
+    formulations are much faster under CPU testing).
+
 The reference pads a short post-NMS set by *re-sampling kept rois*
 (proposal.py pads with random duplicates) so downstream shapes hold; we pad
 with the first kept roi and carry an explicit validity mask — downstream
@@ -28,6 +38,7 @@ from jax import lax
 
 from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
 from mx_rcnn_tpu.ops.nms import nms, nms_bitmask
+from mx_rcnn_tpu.ops.nms_pallas import batched_nms
 
 # Above this many candidate boxes the O(N²) bitmask IoU matrix (~N²·4 bytes
 # plus same-shape temporaries) stops fitting comfortably next to backbone
@@ -46,6 +57,7 @@ def generate_proposals(
     nms_thresh: float,
     min_size: float,
     feat_stride: int = 16,
+    nms_impl: str = "auto",
 ):
     """Batched proposal generation.
 
@@ -59,6 +71,7 @@ def generate_proposals(
       anchors: (H*W*A, 4) from ops.anchors.anchor_grid (static const).
       min_size: min box side at the ORIGINAL scale; scaled by im_scale as in
         the reference (proposal.py: min_size * im_info[2]).
+      nms_impl: "auto" | "pallas" | "xla" (see module docstring).
 
     Returns:
       rois: (B, post_nms_top_n, 4) image-coordinate boxes,
@@ -75,22 +88,36 @@ def generate_proposals(
     scores = fg.reshape(b, -1).astype(jnp.float32)
     deltas = rpn_bbox_pred.reshape(b, -1, 4).astype(jnp.float32)
 
-    return jax.vmap(
-        partial(
-            _proposals_one_image,
-            pre_nms_top_n=pre_nms_top_n,
-            post_nms_top_n=post_nms_top_n,
-            nms_thresh=nms_thresh,
-            min_size=min_size,
-        ),
+    k = min(pre_nms_top_n, scores.shape[1])
+    top_boxes, top_scores, top_valid = jax.vmap(
+        partial(_decode_one_image, pre_nms_top_n=k, min_size=min_size),
         in_axes=(0, 0, 0, None),
     )(scores, deltas, im_info, anchors)
 
+    if nms_impl == "auto":
+        nms_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if nms_impl == "pallas":
+        keep_idx, keep_valid = batched_nms(
+            top_boxes, top_scores, top_valid, nms_thresh, post_nms_top_n)
+    elif nms_impl == "xla":
+        nms_fn = nms_bitmask if k <= _BITMASK_NMS_MAX_BOXES else nms
+        keep_idx, keep_valid = jax.vmap(
+            partial(nms_fn, iou_threshold=nms_thresh, max_output=post_nms_top_n)
+        )(top_boxes, top_scores, top_valid)
+    else:
+        raise ValueError(f"unknown nms_impl {nms_impl!r}")
 
-def _proposals_one_image(
-    scores, deltas, im_info, anchors, *, pre_nms_top_n, post_nms_top_n, nms_thresh, min_size
-):
-    n = anchors.shape[0]
+    rois = jnp.take_along_axis(top_boxes, keep_idx[..., None], axis=1)
+    kept_scores = jnp.take_along_axis(top_scores, keep_idx, axis=1)
+    roi_scores = jnp.where(keep_valid, kept_scores, 0.0)
+    # Pad invalid slots with the first (highest-score) kept roi so downstream
+    # pooling reads a real box; validity mask excludes them from sampling.
+    rois = jnp.where(keep_valid[..., None], rois, rois[:, :1, :])
+    return rois, keep_valid, roi_scores
+
+
+def _decode_one_image(scores, deltas, im_info, anchors, *, pre_nms_top_n, min_size):
+    """Per-image decode: deltas → boxes → clip → min-size mask → top-k."""
     boxes = bbox_pred(anchors, deltas)  # (N, 4)
     boxes = clip_boxes(boxes, (im_info[0], im_info[1]))
     # min-size filter (reference: _filter_boxes with min_size * im_scale).
@@ -100,17 +127,7 @@ def _proposals_one_image(
     size_ok = (ws >= min_sz) & (hs >= min_sz)
     scores = jnp.where(size_ok, scores, -1e10)
     # top-k pre-NMS trim.
-    k = min(pre_nms_top_n, n)
-    top_scores, top_idx = lax.top_k(scores, k)
+    top_scores, top_idx = lax.top_k(scores, pre_nms_top_n)
     top_boxes = boxes[top_idx]
     top_valid = top_scores > -1e9
-    nms_fn = nms_bitmask if k <= _BITMASK_NMS_MAX_BOXES else nms
-    keep_idx, keep_valid = nms_fn(
-        top_boxes, top_scores, top_valid, nms_thresh, post_nms_top_n
-    )
-    rois = top_boxes[keep_idx]
-    roi_scores = jnp.where(keep_valid, top_scores[keep_idx], 0.0)
-    # Pad invalid slots with the first (highest-score) kept roi so downstream
-    # pooling reads a real box; validity mask excludes them from sampling.
-    rois = jnp.where(keep_valid[:, None], rois, rois[0][None, :])
-    return rois, keep_valid, roi_scores
+    return top_boxes, top_scores, top_valid

@@ -1,27 +1,201 @@
-"""ROIAlign / ROIPooling as traceable JAX ops.
+"""ROIAlign / ROIPooling as traceable JAX ops, MXU-formulated.
 
 Replaces MXNet's C++/CUDA builtins ``mx.symbol.ROIPooling`` and
 ``mx.contrib.sym.ROIAlign`` that the reference wires into its graphs
 (rcnn/symbol/symbol_vgg.py 7x7 pool, rcnn/symbol/symbol_resnet.py 14x14 pool,
 spatial_scale 1/16).
 
-Formulation: both ops are expressed as dense gather + weighted reduction over
-a static sampling grid, vmapped over ROIs — XLA lowers the gathers well and
-there are no dynamic shapes. A Pallas fused-gather kernel is the planned fast
-path; this is the semantic reference for it.
+TPU formulation — this is the "Pallas-or-provably-fast" design decision:
+bilinear interpolation is SEPARABLE, so ROIAlign is exactly two small
+matmuls per ROI,
+
+    pooled[i, j, c] = sum_h sum_w  Wy[i, h] * feat[h, w, c] * Wx[j, w]
+
+where ``Wy (P, H)`` / ``Wx (P, W)`` hold the tent-function (hat) bilinear
+weights of each bin's sample points, bin-averaging folded in. That maps the
+op onto the MXU as a batched (R·P, H) x (H, W·C) contraction instead of the
+CUDA kernels' per-point gathers — gathers lower to slow scalar loads on TPU,
+while these matmuls run at MXU rate and their transposes ARE the backward
+pass. A custom Pallas kernel would only re-derive this same schedule, so the
+einsum form is the intended final design, not a stopgap (profiled: the pool
+is <5% of the train step, see tools/profile.py).
 
 - ``roi_align``: bilinear sampling, ``sampling_ratio`` points per bin axis,
   average-pooled (He et al. Mask R-CNN semantics; ``aligned=True`` applies the
   -0.5 half-pixel correction of Detectron2, default False matches the classic
-  MXNet contrib op).
+  MXNet contrib op). Border behavior matches the CUDA kernels: sample coords
+  clamp to the feature extent.
 - ``roi_pool``: quantized max pooling (classic Fast R-CNN semantics used by
-  the reference's training graphs).
+  the reference's training graphs). Max over a rectangular bin is separable
+  too (max over rows, then cols), giving an O(P·H·W·C)/ROI masked reduction
+  instead of the O(P²·H·W·C) dense mask this module used to carry.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+
+def _tent_weights(lo, bin_size, p: int, s: int, extent: int):
+    """Per-bin averaged bilinear sample weights along one axis.
+
+    For bin i, the s sample points sit at ``lo + (i + (k+0.5)/s) * bin_size``;
+    each contributes tent-function (hat) weights to its two integer
+    neighbors. Points are clamped to [0, extent-1] (CUDA-kernel border
+    semantics). Returns (P, extent) float32 with the 1/s bin average folded
+    in, so ``W @ feat`` directly yields bin-averaged bilinear samples.
+    """
+    grid = (jnp.arange(p * s, dtype=jnp.float32) + 0.5) / s  # (p*s,)
+    pts = lo + grid * bin_size
+    pts = jnp.clip(pts, 0.0, extent - 1.0)
+    idx = jnp.arange(extent, dtype=jnp.float32)
+    tent = jnp.maximum(0.0, 1.0 - jnp.abs(pts[:, None] - idx[None, :]))
+    return tent.reshape(p, s, extent).mean(axis=1)  # (p, extent)
+
+
+def roi_align(
+    features: jnp.ndarray,
+    rois: jnp.ndarray,
+    output_size: int,
+    spatial_scale: float,
+    sampling_ratio: int = 2,
+    aligned: bool = False,
+) -> jnp.ndarray:
+    """ROIAlign.
+
+    Args:
+      features: (B, H, W, C) feature maps (NHWC — TPU-native layout; the
+        reference's graphs are NCHW because cuDNN prefers it).
+      rois: (R, 5) rows of (batch_idx, x1, y1, x2, y2) in image coords —
+        same layout as the reference's Proposal op output.
+      output_size: pooled grid side P.
+      spatial_scale: e.g. 1/16 for C4.
+      sampling_ratio: sample points per bin axis.
+      aligned: half-pixel correction.
+
+    Returns: (R, P, P, C), features.dtype.
+    """
+    b, h, w, _ = features.shape
+    p = output_size
+    s = sampling_ratio
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi_weights(roi):
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0) if not aligned else (x2 - x1)
+        rh = jnp.maximum(y2 - y1, 1.0) if not aligned else (y2 - y1)
+        wy = _tent_weights(y1, rh / p, p, s, h)  # (P, H)
+        wx = _tent_weights(x1, rw / p, p, s, w)  # (P, W)
+        return wy, wx
+
+    wy, wx = jax.vmap(one_roi_weights)(rois)  # (R, P, H), (R, P, W)
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    dt = features.dtype
+    wy = wy.astype(dt)
+    wx = wx.astype(dt)
+
+    # Contract against each image's features with the ROI→image assignment
+    # folded into the weights (zeroing non-matching ROIs), summing the per-
+    # image contributions — exactly one image contributes per ROI. This keeps
+    # the contraction a clean (R·P, H) x (H, W·C) matmul per image instead of
+    # a per-ROI feature-map gather (which would materialize (R, H, W, C)).
+    tmp = None
+    for bi in range(b):
+        wy_b = jnp.where((batch_idx == bi)[:, None, None], wy, 0)
+        t = jnp.einsum("rph,hwc->rpwc", wy_b, features[bi],
+                       preferred_element_type=jnp.float32)
+        tmp = t if tmp is None else tmp + t
+    out = jnp.einsum("rqw,rpwc->rpqc", wx, tmp.astype(dt),
+                     preferred_element_type=jnp.float32)
+    return out.astype(dt)
+
+
+def roi_pool(
+    features: jnp.ndarray,
+    rois: jnp.ndarray,
+    output_size: int,
+    spatial_scale: float,
+) -> jnp.ndarray:
+    """Classic quantized max ROIPooling (mx.symbol.ROIPooling semantics).
+
+    Bin boundaries are computed by integer quantization (round of scaled
+    coords, floor/ceil of bin edges); empty bins yield 0 (the CUDA kernel
+    emits 0 for empty bins). Max over a rectangular bin separates into a
+    row-max then a col-max, each a masked reduction over one spatial axis.
+    """
+    p = output_size
+    h, w = features.shape[1], features.shape[2]
+    fy = jnp.arange(h, dtype=jnp.float32)
+    fx = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        # Reference quantizes roi coords with round().
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_w = rw / p
+        bin_h = rh / p
+        i = jnp.arange(p, dtype=jnp.float32)
+        ys_lo = jnp.floor(y1 + i * bin_h)  # (p,)
+        ys_hi = jnp.ceil(y1 + (i + 1.0) * bin_h)
+        xs_lo = jnp.floor(x1 + i * bin_w)
+        xs_hi = jnp.ceil(x1 + (i + 1.0) * bin_w)
+        row_in = (fy[None, :] >= ys_lo[:, None]) & (fy[None, :] < ys_hi[:, None])
+        col_in = (fx[None, :] >= xs_lo[:, None]) & (fx[None, :] < xs_hi[:, None])
+        feat = features[b]  # (H, W, C)
+        neg = jnp.asarray(-jnp.inf, feat.dtype)
+        # Row reduction: (p, H, 1, 1) mask over (H, W, C) -> (p, W, C).
+        rowmax = jnp.where(row_in[:, :, None, None], feat[None], neg).max(axis=1)
+        # Col reduction: (p, W, 1) mask over (p, W, C) -> (p, p, C).
+        out = jnp.where(col_in[None, :, :, None], rowmax[:, None], neg).max(axis=2)
+        return jnp.where(jnp.isfinite(out), out, 0.0).astype(feat.dtype)
+
+    return jax.vmap(one_roi)(rois)
+
+
+def roi_align_gather(
+    features: jnp.ndarray,
+    rois: jnp.ndarray,
+    output_size: int,
+    spatial_scale: float,
+    sampling_ratio: int = 2,
+    aligned: bool = False,
+) -> jnp.ndarray:
+    """Point-gather ROIAlign — the semantic oracle for ``roi_align``.
+
+    Direct transcription of the CUDA kernel's per-sample-point bilinear
+    gather. Kept for differential testing only; the matmul formulation above
+    is the production path (gathers lower poorly on TPU).
+    """
+    p = output_size
+    s = sampling_ratio
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0) if not aligned else (x2 - x1)
+        rh = jnp.maximum(y2 - y1, 1.0) if not aligned else (y2 - y1)
+        grid = (jnp.arange(p * s, dtype=features.dtype) + 0.5) / s
+        ys = y1 + grid * (rh / p)
+        xs = x1 + grid * (rw / p)
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        vals = _bilinear_gather(features[b], yy, xx)  # (p*s, p*s, C)
+        c = vals.shape[-1]
+        return vals.reshape(p, s, p, s, c).mean(axis=(1, 3))
+
+    return jax.vmap(one_roi)(rois)
 
 
 def _bilinear_gather(feat: jnp.ndarray, y: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
@@ -53,103 +227,3 @@ def _bilinear_gather(feat: jnp.ndarray, y: jnp.ndarray, x: jnp.ndarray) -> jnp.n
         + v10 * (ly * hx)[..., None].astype(wdt)
         + v11 * (ly * lx)[..., None].astype(wdt)
     )
-
-
-def roi_align(
-    features: jnp.ndarray,
-    rois: jnp.ndarray,
-    output_size: int,
-    spatial_scale: float,
-    sampling_ratio: int = 2,
-    aligned: bool = False,
-) -> jnp.ndarray:
-    """ROIAlign.
-
-    Args:
-      features: (B, H, W, C) feature maps (NHWC — TPU-native layout; the
-        reference's graphs are NCHW because cuDNN prefers it).
-      rois: (R, 5) rows of (batch_idx, x1, y1, x2, y2) in image coords —
-        same layout as the reference's Proposal op output.
-      output_size: pooled grid side P.
-      spatial_scale: e.g. 1/16 for C4.
-      sampling_ratio: sample points per bin axis.
-      aligned: half-pixel correction.
-
-    Returns: (R, P, P, C).
-    """
-    p = output_size
-    s = sampling_ratio
-    offset = 0.5 if aligned else 0.0
-
-    def one_roi(roi):
-        b = roi[0].astype(jnp.int32)
-        x1 = roi[1] * spatial_scale - offset
-        y1 = roi[2] * spatial_scale - offset
-        x2 = roi[3] * spatial_scale - offset
-        y2 = roi[4] * spatial_scale - offset
-        rw = jnp.maximum(x2 - x1, 1.0) if not aligned else (x2 - x1)
-        rh = jnp.maximum(y2 - y1, 1.0) if not aligned else (y2 - y1)
-        bin_w = rw / p
-        bin_h = rh / p
-        # Sample grid: for bin (i,j), points at
-        # y1 + (i + (k+0.5)/s) * bin_h, k in [0,s)
-        grid = (jnp.arange(p * s, dtype=features.dtype) + 0.5) / s
-        ys = y1 + grid * bin_h  # (p*s,)
-        xs = x1 + grid * bin_w
-        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")  # (p*s, p*s)
-        vals = _bilinear_gather(features[b], yy, xx)  # (p*s, p*s, C)
-        # Average the s*s samples per bin.
-        c = vals.shape[-1]
-        vals = vals.reshape(p, s, p, s, c)
-        return vals.mean(axis=(1, 3))
-
-    return jax.vmap(one_roi)(rois)
-
-
-def roi_pool(
-    features: jnp.ndarray,
-    rois: jnp.ndarray,
-    output_size: int,
-    spatial_scale: float,
-) -> jnp.ndarray:
-    """Classic quantized max ROIPooling (mx.symbol.ROIPooling semantics).
-
-    Bin boundaries are computed by integer quantization (round of scaled
-    coords, floor/ceil of bin edges); empty bins yield 0 (the CUDA kernel
-    emits 0 for empty bins). Implemented densely: for each bin, a max over a
-    masked window of the (static) feature map — O(P²·H·W) per ROI is fine at
-    C4 sizes (64×64 feature map) and keeps shapes static.
-    """
-    p = output_size
-    h, w = features.shape[1], features.shape[2]
-    fy = jnp.arange(h, dtype=jnp.float32)
-    fx = jnp.arange(w, dtype=jnp.float32)
-
-    def one_roi(roi):
-        b = roi[0].astype(jnp.int32)
-        # Reference quantizes roi coords with round().
-        x1 = jnp.round(roi[1] * spatial_scale)
-        y1 = jnp.round(roi[2] * spatial_scale)
-        x2 = jnp.round(roi[3] * spatial_scale)
-        y2 = jnp.round(roi[4] * spatial_scale)
-        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
-        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
-        bin_w = rw / p
-        bin_h = rh / p
-        i = jnp.arange(p, dtype=jnp.float32)
-        ys_lo = jnp.floor(y1 + i * bin_h)  # (p,)
-        ys_hi = jnp.ceil(y1 + (i + 1.0) * bin_h)
-        xs_lo = jnp.floor(x1 + i * bin_w)
-        xs_hi = jnp.ceil(x1 + (i + 1.0) * bin_w)
-        # Mask (p, H): feature row r in bin i iff ys_lo[i] <= r < ys_hi[i].
-        row_in = (fy[None, :] >= ys_lo[:, None]) & (fy[None, :] < ys_hi[:, None])
-        col_in = (fx[None, :] >= xs_lo[:, None]) & (fx[None, :] < xs_hi[:, None])
-        feat = features[b]  # (H, W, C)
-        neg = jnp.asarray(-jnp.inf, feat.dtype)
-        # (p, 1, H, 1, 1) & (1, p, 1, W, 1) -> mask (p,p,H,W,1)
-        mask = row_in[:, None, :, None, None] & col_in[None, :, None, :, None]
-        masked = jnp.where(mask, feat[None, None], neg)
-        out = masked.max(axis=(2, 3))  # (p, p, C)
-        return jnp.where(jnp.isfinite(out), out, 0.0).astype(feat.dtype)
-
-    return jax.vmap(one_roi)(rois)

@@ -111,9 +111,19 @@ def load_checkpoint(prefix: str, epoch: int, *, template=None,
     if item is not None and "opt_state" not in item and _has_opt_state(path):
         # Inference-time load of a training checkpoint: restore params only,
         # skipping the saved opt_state (orbax rejects the structure mismatch
-        # otherwise).
-        restored = ckptr.restore(
-            path, args=ocp.args.PyTreeRestore(item=item, partial_restore=True))
+        # otherwise). partial_restore needs orbax >= 0.5.21; older versions
+        # raise TypeError on the kwarg — fall back to restoring the params
+        # subtree directly from its subdirectory.
+        try:
+            restored = ckptr.restore(
+                path, args=ocp.args.PyTreeRestore(item=item,
+                                                  partial_restore=True))
+        except TypeError:
+            # Untyped full restore of the whole checkpoint (including the
+            # opt_state, which is discarded): flax params are plain dicts,
+            # so dropping the item template only loses dtype coercion —
+            # acceptable for the legacy-orbax inference path.
+            restored = {"params": ckptr.restore(path)["params"]}
     else:
         restored = ckptr.restore(path, item=item)
     params = restored["params"]

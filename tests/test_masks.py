@@ -1,0 +1,139 @@
+"""RLE mask API (mx_rcnn_tpu/masks) vs hand-computed cases.
+
+The reference vendors pycocotools' C maskApi (rcnn/pycocotools/maskApi.c);
+pycocotools is not installed in this environment (SURVEY.md §8), so these
+tests pin the format with hand-built fixtures: column-major run order, the
+COCO varint/delta string codec, crowd IoU semantics.
+"""
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu import masks
+
+
+def test_encode_decode_roundtrip():
+    rs = np.random.RandomState(0)
+    for _ in range(10):
+        m = (rs.rand(13, 7) > 0.5).astype(np.uint8)
+        assert np.array_equal(masks.decode(masks.encode(m)), m)
+
+
+def test_counts_column_major_order():
+    # 2x3 mask, only pixel (row 1, col 0) set. Column-major flat order is
+    # [m[0,0], m[1,0], m[0,1], m[1,1], m[0,2], m[1,2]] = [0,1,0,0,0,0]
+    # -> runs [1, 1, 4].
+    m = np.zeros((2, 3), np.uint8)
+    m[1, 0] = 1
+    rle = masks.encode(m)
+    assert masks.decompress(rle["counts"]) == [1, 1, 4]
+
+
+def test_counts_leading_one_starts_with_zero_run():
+    m = np.ones((2, 2), np.uint8)
+    rle = masks.encode(m)
+    assert masks.decompress(rle["counts"]) == [0, 4]
+
+
+def test_compress_roundtrip_various():
+    cases = [
+        [0, 4],
+        [1, 1, 4],
+        [5, 10, 3, 200, 7],
+        [100000, 1, 100000],  # multi-chunk varints
+        [0, 1, 0, 1, 0, 1],   # deltas go negative
+    ]
+    for counts in cases:
+        assert masks.decompress(masks.compress(counts)) == counts
+
+
+def test_compress_known_string():
+    # maskApi rleToString stores the first THREE counts raw (delta only from
+    # i=3): [1, 1, 4] -> chr(1+48) chr(1+48) chr(4+48) = b"114";
+    # [1, 1, 4, 2] appends delta 2-1=1 -> b"1141".
+    assert masks.compress([1, 1, 4]) == b"114"
+    assert masks.decompress(b"114") == [1, 1, 4]
+    assert masks.compress([1, 1, 4, 2]) == b"1141"
+    assert masks.decompress(b"1141") == [1, 1, 4, 2]
+
+
+def test_area():
+    m = np.zeros((4, 4), np.uint8)
+    m[1:3, 1:4] = 1
+    assert masks.area(masks.encode(m)) == 6
+
+
+def test_merge_union_and_intersect():
+    a = np.zeros((4, 4), np.uint8)
+    b = np.zeros((4, 4), np.uint8)
+    a[0:2, 0:2] = 1
+    b[1:3, 1:3] = 1
+    union = masks.decode(masks.merge([masks.encode(a), masks.encode(b)]))
+    inter = masks.decode(
+        masks.merge([masks.encode(a), masks.encode(b)], intersect=True))
+    assert union.sum() == 7
+    assert inter.sum() == 1
+    assert inter[1, 1] == 1
+
+
+def test_iou_plain_and_crowd():
+    a = np.zeros((4, 4), np.uint8)
+    b = np.zeros((4, 4), np.uint8)
+    a[0:2, 0:2] = 1  # area 4
+    b[0:4, 0:2] = 1  # area 8, contains a
+    ra, rb = masks.encode(a), masks.encode(b)
+    plain = masks.iou([ra], [rb], [False])
+    assert plain[0, 0] == pytest.approx(4 / 8)
+    # Crowd gt: intersection over DETECTION area = 4/4 = 1.
+    crowd = masks.iou([ra], [rb], [True])
+    assert crowd[0, 0] == pytest.approx(1.0)
+
+
+def test_to_bbox():
+    m = np.zeros((10, 10), np.uint8)
+    m[2:5, 3:9] = 1
+    assert masks.to_bbox(masks.encode(m)).tolist() == [3.0, 2.0, 6.0, 3.0]
+
+
+def test_poly_to_mask_rectangle():
+    # Axis-aligned rectangle covering pixel centers in cols 1..3, rows 1..2.
+    poly = [1.0, 1.0, 4.0, 1.0, 4.0, 3.0, 1.0, 3.0]
+    m = masks.poly_to_mask(poly, 5, 6)
+    want = np.zeros((5, 6), np.uint8)
+    want[1:3, 1:4] = 1
+    assert np.array_equal(m, want)
+
+
+def test_poly_to_mask_triangle_even_odd():
+    # Right triangle (0,0)-(6,0)-(0,6): pixel center (x+.5, y+.5) is inside
+    # iff x + y < 5 (strictly below the hypotenuse x+y=6 sampled at centers).
+    poly = [0.0, 0.0, 6.0, 0.0, 0.0, 6.0]
+    m = masks.poly_to_mask(poly, 6, 6)
+    for y in range(6):
+        for x in range(6):
+            assert m[y, x] == (1 if x + y < 5 else 0), (x, y)
+
+
+def test_fr_bbox():
+    rle = masks.fr_bbox([1.0, 2.0, 3.0, 2.0], 6, 6)
+    m = masks.decode(rle)
+    want = np.zeros((6, 6), np.uint8)
+    want[2:4, 1:4] = 1
+    assert np.array_equal(m, want)
+
+
+def test_fr_py_objects_dispatch():
+    # Polygon list form.
+    r1 = masks.fr_py_objects([[1.0, 1.0, 4.0, 1.0, 4.0, 3.0, 1.0, 3.0]], 5, 6)
+    assert masks.area(r1) == 6
+    # Uncompressed dict form.
+    r2 = masks.fr_py_objects({"size": [2, 3], "counts": [1, 1, 4]}, 2, 3)
+    assert masks.decode(r2)[1, 0] == 1
+    # Compressed passes through.
+    r3 = masks.fr_py_objects({"size": [2, 3], "counts": b"114"}, 2, 3)
+    assert np.array_equal(masks.decode(r3), masks.decode(r2))
+
+
+def test_decode_rejects_bad_length():
+    with pytest.raises(ValueError):
+        masks.decode({"size": [2, 2], "counts": [1, 1]})
