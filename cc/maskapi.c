@@ -1,0 +1,158 @@
+/* RLE mask kernels — the native core of the mask toolkit.
+ *
+ * Reference analog: rcnn/pycocotools/maskApi.c (the C RLE engine under the
+ * vendored pycocotools). Original implementation for the TPU build: the
+ * Python layer (mx_rcnn_tpu/masks/rle.py) is the semantic reference; this
+ * file provides the hot dense-mask paths via ctypes
+ * (mx_rcnn_tpu/masks/_native.py), operating directly on run lists so merge
+ * and IoU never materialize dense masks.
+ *
+ * Conventions (identical to the Python layer):
+ *   - masks are column-major (Fortran) flattened H*W uint8 arrays;
+ *   - counts alternate 0-run/1-run lengths starting with a (possibly
+ *     empty) 0-run;
+ *   - crowd IoU = intersection / detection area.
+ *
+ * Build: gcc -O2 -shared -fPIC -o libmaskapi.so maskapi.c
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+
+/* mask (h*w, column-major flat) -> counts; returns n_counts (<= h*w + 1). */
+long rle_encode(const uint8_t *mask, long n, uint32_t *counts) {
+    long m = 0;
+    uint8_t cur = 0; /* counts start with the 0-run */
+    uint32_t run = 0;
+    for (long i = 0; i < n; i++) {
+        uint8_t v = mask[i] ? 1 : 0;
+        if (v != cur) {
+            counts[m++] = run;
+            run = 0;
+            cur = v;
+        }
+        run++;
+    }
+    counts[m++] = run;
+    return m;
+}
+
+/* counts -> mask (caller-allocated n bytes). Returns 0 ok, -1 length err. */
+long rle_decode(const uint32_t *counts, long m, uint8_t *mask, long n) {
+    long pos = 0;
+    uint8_t val = 0;
+    for (long i = 0; i < m; i++) {
+        uint32_t c = counts[i];
+        if (pos + (long)c > n) return -1;
+        for (uint32_t j = 0; j < c; j++) mask[pos++] = val;
+        val ^= 1;
+    }
+    return pos == n ? 0 : -1;
+}
+
+long rle_area(const uint32_t *counts, long m) {
+    long a = 0;
+    for (long i = 1; i < m; i += 2) a += counts[i];
+    return a;
+}
+
+/* Run-walking iterator over one RLE. it.i's parity is the pixel value. */
+typedef struct {
+    const uint32_t *c;
+    long m;        /* number of counts */
+    long i;        /* current run index */
+    uint32_t left; /* remaining pixels in current run */
+} rle_iter;
+
+static void it_skip_empty(rle_iter *it) {
+    while (it->left == 0 && it->i + 1 < it->m) {
+        it->i++;
+        it->left = it->c[it->i];
+    }
+}
+
+static void it_init(rle_iter *it, const uint32_t *c, long m) {
+    it->c = c;
+    it->m = m;
+    it->i = 0;
+    it->left = (m > 0) ? c[0] : 0;
+    it_skip_empty(it);
+}
+
+static uint8_t it_val(const rle_iter *it) { return (uint8_t)(it->i & 1); }
+
+static void it_advance(rle_iter *it, uint32_t step) {
+    it->left -= step;
+    it_skip_empty(it);
+}
+
+/* Merge two RLEs of EQUAL total length by walking runs in lockstep.
+ * intersect=0 -> union, 1 -> intersection. Returns n_counts_out
+ * (out must hold ma + mb entries). */
+long rle_merge(const uint32_t *ca, long ma, const uint32_t *cb, long mb,
+               uint32_t *out, int intersect) {
+    rle_iter a, b;
+    it_init(&a, ca, ma);
+    it_init(&b, cb, mb);
+    long mo = 0;
+    uint8_t cur = 0;
+    uint32_t run = 0;
+    while (a.left > 0 && b.left > 0) {
+        uint32_t step = a.left < b.left ? a.left : b.left;
+        uint8_t v = intersect ? (it_val(&a) & it_val(&b))
+                              : (it_val(&a) | it_val(&b));
+        if (v != cur) {
+            out[mo++] = run;
+            run = 0;
+            cur = v;
+        }
+        run += step;
+        it_advance(&a, step);
+        it_advance(&b, step);
+    }
+    out[mo++] = run;
+    return mo;
+}
+
+/* Intersection area of two RLEs (no dense mask). */
+static long rle_inter_area(const uint32_t *ca, long ma,
+                           const uint32_t *cb, long mb) {
+    rle_iter a, b;
+    it_init(&a, ca, ma);
+    it_init(&b, cb, mb);
+    long inter = 0;
+    while (a.left > 0 && b.left > 0) {
+        uint32_t step = a.left < b.left ? a.left : b.left;
+        if (it_val(&a) && it_val(&b)) inter += step;
+        it_advance(&a, step);
+        it_advance(&b, step);
+    }
+    return inter;
+}
+
+/* Pairwise IoU matrix: dts (D RLEs) x gts (G RLEs) -> out[D*G] row-major.
+ * Counts are packed back-to-back; offsets/lengths index into them.
+ * iscrowd[g] != 0 -> intersection / det area. */
+void rle_iou(const uint32_t *dt_counts, const long *dt_off, const long *dt_len,
+             long n_dt,
+             const uint32_t *gt_counts, const long *gt_off, const long *gt_len,
+             long n_gt,
+             const uint8_t *iscrowd, double *out) {
+    for (long d = 0; d < n_dt; d++) {
+        const uint32_t *cd = dt_counts + dt_off[d];
+        long md = dt_len[d];
+        long ad = rle_area(cd, md);
+        for (long g = 0; g < n_gt; g++) {
+            const uint32_t *cg = gt_counts + gt_off[g];
+            long mg = gt_len[g];
+            long inter = rle_inter_area(cd, md, cg, mg);
+            double denom;
+            if (iscrowd[g]) {
+                denom = (double)ad;
+            } else {
+                denom = (double)(ad + rle_area(cg, mg) - inter);
+            }
+            out[d * n_gt + g] = denom > 0 ? (double)inter / denom : 0.0;
+        }
+    }
+}

@@ -18,7 +18,7 @@ from mx_rcnn_tpu.data.image import (
     load_image, pad_image, resize_image, transform_image)
 from mx_rcnn_tpu.evaluation.tester import Predictor, im_detect
 from mx_rcnn_tpu.logger import logger
-from mx_rcnn_tpu.models.faster_rcnn import build_model, init_params
+from mx_rcnn_tpu.models.zoo import build_model, init_params
 from mx_rcnn_tpu.train.checkpoint import load_checkpoint
 from mx_rcnn_tpu.utils.vis import draw_detections
 

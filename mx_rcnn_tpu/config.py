@@ -37,6 +37,14 @@ class NetworkConfig:
     # Channels of the stride-16 feature map (C4): 1024 for ResNet, 512 VGG.
     feat_channels: int = 1024
     depth: int = 50  # resnet depth; unused for vgg
+    # Backbone normalization: "frozen_bn" (reference parity — REQUIRES
+    # pretrained statistics to be restored) or "group" (GroupNorm; the
+    # stable choice for from-scratch training, see models/backbones.py).
+    norm: str = "frozen_bn"
+    # Stop-gradient freeze cut: 0 = none, 1 = stem, 2 = stem+stage1
+    # (reference fixed_param_prefix default). Use 0 when training from
+    # scratch — freezing random weights is pointless.
+    freeze_at: int = 2
     # bfloat16 compute for conv/matmul path.
     compute_dtype: str = "bfloat16"
     # FPN (off for the classic C4 configs).
@@ -97,6 +105,12 @@ class TrainConfig:
     aspect_grouping: bool = True
     # Static-shape padding (TPU design decision — no reference equivalent).
     max_gt_boxes: int = 100
+    # FPN proposal budget per pyramid level (Detectron convention: 2000/level
+    # at train time); only read when network.use_fpn.
+    fpn_rpn_pre_nms_per_level: int = 2000
+    # Mask target rasterization resolution (gt instance masks are stored
+    # box-frame at this size; only read when network.use_mask).
+    mask_gt_resolution: int = 56
     # Loss scaling constants (reference scales smooth-L1 by 1/RPN_BATCH and
     # 1/BATCH_ROIS via grad_scale, NOT by live fg counts).
     # end2end switch retained for the alternate-training tools.
@@ -121,6 +135,8 @@ class TestConfig:
     proposal_nms_thresh: float = 0.7
     proposal_pre_nms_top_n: int = 20000
     proposal_post_nms_top_n: int = 2000
+    # FPN per-level proposal budget at test time (Detectron: 1000/level).
+    fpn_rpn_pre_nms_per_level: int = 1000
 
 
 @dataclass(frozen=True)

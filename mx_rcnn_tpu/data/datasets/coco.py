@@ -67,7 +67,7 @@ class COCODataset(IMDB):
         for im_id in sorted(images):
             im = images[im_id]
             anns = anns_by_image.get(im_id, [])
-            boxes, classes = [], []
+            boxes, classes, segs = [], [], []
             w, h = im["width"], im["height"]
             for a in anns:
                 x, y, bw, bh = a["bbox"]
@@ -78,6 +78,12 @@ class COCODataset(IMDB):
                 if a.get("area", 0) > 0 and x2 >= x1 and y2 >= y1:
                     boxes.append([x1, y1, x2, y2])
                     classes.append(self._cat_to_class[a["category_id"]])
+                    # Polygon segmentations feed the mask pipeline
+                    # (data/loader.py rasterizes box-frame gt masks); non-
+                    # polygon (RLE) forms only occur on crowd anns, which
+                    # are filtered above.
+                    seg = a.get("segmentation")
+                    segs.append(seg if isinstance(seg, list) else None)
             roidb.append({
                 "index": im_id,
                 "image": self._image_path(im),
@@ -85,6 +91,7 @@ class COCODataset(IMDB):
                 "width": w,
                 "boxes": np.asarray(boxes, np.float32).reshape(-1, 4),
                 "gt_classes": np.asarray(classes, np.int32),
+                "segmentations": segs,
                 "flipped": False,
             })
         return roidb
@@ -125,3 +132,50 @@ class COCODataset(IMDB):
         evaluator = COCOEval(data, results)
         stats = evaluator.summarize()
         return stats
+
+    def evaluate_segmentations(self, all_boxes, all_masks,
+                               out_json: str = None, **kwargs):
+        """Instance-segmentation eval: bbox AND segm COCO metrics.
+
+        all_masks mirrors all_boxes: all_masks[class][image] is a list of
+        RLE dicts (mx_rcnn_tpu.masks format) aligned row-for-row with
+        all_boxes[class][image]. Reference analog: coco.py's segm results
+        path through vendored pycocotools COCOeval(iouType='segm').
+        """
+        from mx_rcnn_tpu.evaluation.coco_eval import COCOEval
+
+        images, _, data = self._load_index()
+        image_ids = sorted(images)
+        results = []
+        for c in range(1, self.num_classes):
+            cat_id = self._class_to_cat[c]
+            for i, im_id in enumerate(image_ids):
+                dets = all_boxes[c][i]
+                rles = all_masks[c][i]
+                if dets is None or len(dets) == 0:
+                    continue
+                for d, rle in zip(np.asarray(dets), rles):
+                    counts = rle["counts"]
+                    if isinstance(counts, bytes):
+                        counts = counts.decode("ascii")
+                    results.append({
+                        "image_id": int(im_id),
+                        "category_id": int(cat_id),
+                        "bbox": [float(d[0]), float(d[1]),
+                                 float(d[2] - d[0] + 1),
+                                 float(d[3] - d[1] + 1)],
+                        "score": float(d[4]),
+                        "segmentation": {"size": list(rle["size"]),
+                                         "counts": counts},
+                    })
+        if out_json:
+            os.makedirs(os.path.dirname(out_json) or ".", exist_ok=True)
+            with open(out_json, "w") as f:
+                json.dump(results, f)
+            logger.info("wrote %d segm results to %s", len(results), out_json)
+        box_stats = COCOEval(data, results).summarize()
+        segm_stats = COCOEval(data, results, iou_type="segm").summarize()
+        out = dict(box_stats)
+        out.update({f"segm_{k}": v for k, v in segm_stats.items()})
+        out["mAP"] = box_stats["AP"]
+        return out

@@ -46,9 +46,17 @@ class IMDB:
 
     # -- roidb ------------------------------------------------------------
 
+    # Bump when the roidb record schema changes — a stale pickle from an
+    # older schema must be rebuilt, not silently reused (e.g. v2 added
+    # 'segmentations', without which mask targets degrade to box masks).
+    ROIDB_SCHEMA_VERSION = 2
+
     def gt_roidb(self) -> List[Dict]:
-        """Ground-truth roidb with a pickle cache (reference behavior)."""
-        cache_file = os.path.join(self.cache_path, f"{self.name}_gt_roidb.pkl")
+        """Ground-truth roidb with a pickle cache (reference behavior,
+        plus schema versioning the reference lacks)."""
+        cache_file = os.path.join(
+            self.cache_path,
+            f"{self.name}_gt_roidb_v{self.ROIDB_SCHEMA_VERSION}.pkl")
         if os.path.exists(cache_file):
             with open(cache_file, "rb") as f:
                 roidb = pickle.load(f)

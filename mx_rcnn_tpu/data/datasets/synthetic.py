@@ -31,12 +31,22 @@ class SyntheticDataset(IMDB):
 
     def __init__(self, image_set: str, root_path: str = "data",
                  dataset_path: str = "", num_images: int = 32,
-                 image_size: int = 320, max_objects: int = 4, seed: int = 0):
+                 image_size: int = 320, max_objects: int = 4, seed: int = 0,
+                 with_masks: bool = False, mask_resolution: int = 56,
+                 min_size_frac: int = 8, max_size_frac: int = 2):
         super().__init__("synthetic", image_set, root_path, dataset_path)
         self.classes = self.classes_tuple
         self.num_images = num_images
         self.image_size = image_size
         self.max_objects = max_objects
+        # Mask mode draws filled ellipses (so instance masks differ from the
+        # boxes) and attaches box-frame gt_masks to every roidb entry.
+        self.with_masks = with_masks
+        self.mask_resolution = mask_resolution
+        # Object side range: [s/min_size_frac, s/max_size_frac). Tests use a
+        # narrower, larger range to keep tiny-image training learnable.
+        self.min_size_frac = min_size_frac
+        self.max_size_frac = max_size_frac
         # crc32, not hash(): str hashing is randomized per process and would
         # break the deterministic-per-(split, index) contract.
         self._seed = seed + (zlib.crc32(image_set.encode()) % 1000)
@@ -49,24 +59,39 @@ class SyntheticDataset(IMDB):
         s = self.image_size
         img = rs.uniform(80, 150, (s, s, 3)).astype(np.float32)
         n = rs.randint(1, self.max_objects + 1)
-        boxes, classes = [], []
+        boxes, classes, gmasks = [], [], []
         for _ in range(n):
-            w = rs.randint(s // 8, s // 2)
-            h = rs.randint(s // 8, s // 2)
+            w = rs.randint(s // self.min_size_frac, s // self.max_size_frac)
+            h = rs.randint(s // self.min_size_frac, s // self.max_size_frac)
             x1 = rs.randint(0, s - w)
             y1 = rs.randint(0, s - h)
             cls = rs.randint(1, len(self.classes))
             color = _CLASS_COLORS[cls] + rs.uniform(-15, 15, 3)
-            img[y1:y1 + h, x1:x1 + w] = color
+            if self.with_masks:
+                # Filled ellipse inscribed in the box: the instance mask is
+                # a strict subset of the box, exercising the mask pipeline.
+                yy, xx = np.mgrid[0:h, 0:w]
+                ell = (((xx - (w - 1) / 2) / (w / 2)) ** 2
+                       + ((yy - (h - 1) / 2) / (h / 2)) ** 2) <= 1.0
+                region = img[y1:y1 + h, x1:x1 + w]
+                region[ell] = color
+                m = self.mask_resolution
+                yi = np.minimum((np.arange(m) * h // m), h - 1)
+                xi = np.minimum((np.arange(m) * w // m), w - 1)
+                gmasks.append(ell[np.ix_(yi, xi)].astype(np.uint8))
+            else:
+                img[y1:y1 + h, x1:x1 + w] = color
             boxes.append([x1, y1, x1 + w - 1, y1 + h - 1])
             classes.append(cls)
-        return img, np.asarray(boxes, np.float32), np.asarray(classes, np.int32)
+        return (img, np.asarray(boxes, np.float32),
+                np.asarray(classes, np.int32),
+                np.asarray(gmasks, np.uint8) if gmasks else None)
 
     def _load_gt_roidb(self) -> List[Dict]:
         roidb = []
         for i in range(self.num_images):
-            img, boxes, classes = self._gen(i)
-            roidb.append({
+            img, boxes, classes, gmasks = self._gen(i)
+            entry = {
                 "index": i,
                 "image_data": img,
                 "height": img.shape[0],
@@ -74,7 +99,10 @@ class SyntheticDataset(IMDB):
                 "boxes": boxes,
                 "gt_classes": classes,
                 "flipped": False,
-            })
+            }
+            if gmasks is not None:
+                entry["gt_masks"] = gmasks
+            roidb.append(entry)
         return roidb
 
     def evaluate_detections(self, all_boxes, iou_thresh: float = 0.5,

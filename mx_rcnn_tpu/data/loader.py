@@ -63,6 +63,42 @@ def _pad_gt(boxes: np.ndarray, classes: np.ndarray, max_gt: int):
     return out_b, out_c, out_v
 
 
+def _entry_gt_masks(entry: Dict, m: int, max_gt: int) -> np.ndarray:
+    """Box-frame (max_gt, m, m) instance masks for one roidb entry.
+
+    Sources, in priority order: a precomputed entry["gt_masks"] (G, m', m')
+    array (synthetic dataset / caches; nearest-resampled if m' != m), or
+    entry["segmentations"] polygon lists rasterized against entry["boxes"]
+    (COCO). Missing masks default to all-ones (box == mask). Horizontal flip
+    (entry["flipped"]) mirrors the box-frame mask content — the box coords
+    were already mirrored by the imdb."""
+    from mx_rcnn_tpu import masks as _masks
+
+    boxes = entry["boxes"]
+    g = min(len(boxes), max_gt)
+    out = np.zeros((max_gt, m, m), np.uint8)
+    pre = entry.get("gt_masks")
+    segs = entry.get("segmentations")
+    for i in range(g):
+        if pre is not None:
+            mm = pre[i]
+            if mm.shape != (m, m):
+                yi = (np.arange(m) * mm.shape[0] // m)
+                xi = (np.arange(m) * mm.shape[1] // m)
+                mm = mm[np.ix_(yi, xi)]
+            out[i] = mm.astype(np.uint8)
+        elif segs is not None and segs[i]:
+            # roidb boxes and polygons are both stored unflipped (the loader
+            # mirrors at load time), so they line up directly; the content
+            # mirror below handles the flipped copies.
+            out[i] = _masks.poly_box_frame_mask(segs[i], boxes[i], m)
+        else:
+            out[i] = 1
+    if entry.get("flipped"):
+        out = out[:, :, ::-1]
+    return out
+
+
 class _PrefetchIterator:
     """Thread-pool prefetcher: indices → assembled batches, `depth` ahead.
 
@@ -137,10 +173,19 @@ class AnchorLoader:
 
     def __init__(self, roidb: List[Dict], cfg: Config, num_shards: int = 1,
                  shuffle: Optional[bool] = None, seed: int = 0,
-                 prefetch_depth: int = 4, workers: int = 4):
+                 prefetch_depth: int = 4, workers: int = 4,
+                 process_count: int = 1, process_index: int = 0):
+        """num_shards = data-axis shards THIS process feeds. Multi-host
+        (process_count > 1): every process must use the SAME seed — the
+        epoch order is computed over the global batch and each process
+        loads its own column slice, preserving exact global-batch DP
+        semantics (parallel/distributed.py)."""
         self.roidb = roidb
         self.cfg = cfg
         self.batch_size = cfg.train.batch_images * num_shards
+        self.process_count = process_count
+        self.process_index = process_index
+        self.global_batch_size = self.batch_size * process_count
         self.shuffle = cfg.train.shuffle if shuffle is None else shuffle
         self.aspect_grouping = cfg.train.aspect_grouping
         self._rng = np.random.RandomState(seed)
@@ -148,7 +193,7 @@ class AnchorLoader:
         self._workers = workers
 
     def __len__(self):
-        return len(self.roidb) // self.batch_size
+        return len(self.roidb) // self.global_batch_size
 
     def _epoch_order(self) -> np.ndarray:
         n = len(self.roidb)
@@ -164,9 +209,10 @@ class AnchorLoader:
             self._rng.shuffle(horz)
             self._rng.shuffle(vert)
             inds = np.hstack([horz, vert])
-            # Shuffle at batch granularity to keep groups together.
-            nb = n // self.batch_size
-            trimmed = inds[: nb * self.batch_size].reshape(nb, self.batch_size)
+            # Shuffle at (global) batch granularity to keep groups together.
+            gb = self.global_batch_size
+            nb = n // gb
+            trimmed = inds[: nb * gb].reshape(nb, gb)
             self._rng.shuffle(trimmed)
             return trimmed.reshape(-1)
         inds = np.arange(n)
@@ -176,27 +222,40 @@ class AnchorLoader:
     def _make_batch(self, idxs: np.ndarray) -> Dict[str, np.ndarray]:
         cfg = self.cfg
         g = cfg.train.max_gt_boxes
-        imgs, infos, gtb, gtc, gtv = [], [], [], [], []
+        with_masks = cfg.network.use_mask
+        m = cfg.train.mask_gt_resolution
+        imgs, infos, gtb, gtc, gtv, gtm = [], [], [], [], [], []
         for i in idxs:
-            img, info, boxes, classes = _load_roidb_entry(self.roidb[i], cfg)
+            entry = self.roidb[i]
+            img, info, boxes, classes = _load_roidb_entry(entry, cfg)
             b, c, v = _pad_gt(boxes, classes, g)
             imgs.append(img)
             infos.append(info)
             gtb.append(b)
             gtc.append(c)
             gtv.append(v)
-        return {
+            if with_masks:
+                gtm.append(_entry_gt_masks(entry, m, g))
+        batch = {
             "image": np.stack(imgs),
             "im_info": np.stack(infos),
             "gt_boxes": np.stack(gtb),
             "gt_classes": np.stack(gtc),
             "gt_valid": np.stack(gtv),
         }
+        if with_masks:
+            batch["gt_masks"] = np.stack(gtm)
+        return batch
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         order = self._epoch_order()
-        nb = len(order) // self.batch_size
-        batches = order[: nb * self.batch_size].reshape(nb, self.batch_size)
+        gb = self.global_batch_size
+        nb = len(order) // gb
+        batches = order[: nb * gb].reshape(nb, gb)
+        # Multi-host: this process loads only its column slice of each
+        # global batch (same order on every process — same seed).
+        lo = self.process_index * self.batch_size
+        batches = batches[:, lo:lo + self.batch_size]
         it = _PrefetchIterator(self._make_batch, batches,
                                depth=self._depth, workers=self._workers)
         try:

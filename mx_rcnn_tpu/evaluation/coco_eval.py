@@ -55,12 +55,24 @@ def bbox_iou_xywh(dets: np.ndarray, gts: np.ndarray,
 
 
 class COCOEval:
-    """Bbox evaluation of a results list against an instances-json dict."""
+    """Evaluation of a results list against an instances-json dict.
+
+    iou_type: "bbox" (default) or "segm". Segm mode matches with RLE mask
+    IoU (mx_rcnn_tpu.masks — the maskApi path of the reference's vendored
+    pycocotools): gt `segmentation` fields (polygons or RLE) are rasterized
+    per image, detections must carry an RLE `segmentation`, and areas come
+    from the masks, as COCOeval's segm iouType does.
+    """
 
     def __init__(self, dataset: Dict, results: Sequence[Dict],
-                 max_dets: Sequence[int] = MAX_DETS):
+                 max_dets: Sequence[int] = MAX_DETS, iou_type: str = "bbox"):
+        if iou_type not in ("bbox", "segm"):
+            raise ValueError(f"unknown iou_type {iou_type!r}")
+        self.iou_type = iou_type
         self.max_dets = tuple(max_dets)
         self.img_ids = sorted(im["id"] for im in dataset["images"])
+        self._img_size = {im["id"]: (im["height"], im["width"])
+                          for im in dataset["images"]}
         self.cat_ids = sorted(c["id"] for c in dataset["categories"])
         self._gts = defaultdict(list)
         for ann in dataset["annotations"]:
@@ -72,7 +84,8 @@ class COCOEval:
 
     # -- per image/category matching --------------------------------------
 
-    def _evaluate_img(self, gts, gt_areas, iscrowd, dts, ious, area_rng):
+    def _evaluate_img(self, gts, gt_areas, iscrowd, dts, ious, area_rng,
+                      d_areas=None):
         """Greedy matching for one (image, category, area-range) cell.
 
         gts/dts are already sorted (dets by score desc, capped at
@@ -120,7 +133,8 @@ class COCOEval:
                 dt_ignore[t_idx, di] = gt_ignore[mm]
                 gt_match[t_idx, mm] = True
         # Detections outside the area range and unmatched → ignored.
-        d_areas = d_boxes[:, 2] * d_boxes[:, 3]
+        if d_areas is None:  # bbox mode; segm passes mask areas
+            d_areas = d_boxes[:, 2] * d_boxes[:, 3]
         d_out = (d_areas < area_rng[0]) | (d_areas >= area_rng[1])
         dt_ignore |= (~dt_match) & d_out[None, :]
         return {
@@ -146,16 +160,32 @@ class COCOEval:
             dts = [dts[i] for i in d_order]
             iscrowd = np.array([bool(g.get("iscrowd", 0)) for g in gts], bool)
             gt_areas = [g.get("area", g["bbox"][2] * g["bbox"][3]) for g in gts]
-            g_boxes = np.array([g["bbox"] for g in gts],
-                               np.float64).reshape(-1, 4)
-            d_boxes = np.array([d["bbox"] for d in dts],
-                               np.float64).reshape(-1, 4)
-            ious = (bbox_iou_xywh(d_boxes, g_boxes, iscrowd)
-                    if len(gts) and len(dts)
-                    else np.zeros((len(dts), len(gts))))
+            d_areas = None
+            if self.iou_type == "segm":
+                from mx_rcnn_tpu import masks as _masks
+
+                h, w = self._img_size[img_id]
+                g_rles = [_masks.fr_py_objects(g["segmentation"], h, w)
+                          for g in gts]
+                d_rles = [_masks.fr_py_objects(d["segmentation"], h, w)
+                          for d in dts]
+                ious = (_masks.iou(d_rles, g_rles, iscrowd.tolist())
+                        if len(gts) and len(dts)
+                        else np.zeros((len(dts), len(gts))))
+                d_areas = np.array([_masks.area(r) for r in d_rles],
+                                   np.float64)
+            else:
+                g_boxes = np.array([g["bbox"] for g in gts],
+                                   np.float64).reshape(-1, 4)
+                d_boxes = np.array([d["bbox"] for d in dts],
+                                   np.float64).reshape(-1, 4)
+                ious = (bbox_iou_xywh(d_boxes, g_boxes, iscrowd)
+                        if len(gts) and len(dts)
+                        else np.zeros((len(dts), len(gts))))
             for name, rng in AREA_RANGES.items():
                 per_area[name].append(
-                    self._evaluate_img(gts, gt_areas, iscrowd, dts, ious, rng))
+                    self._evaluate_img(gts, gt_areas, iscrowd, dts, ious, rng,
+                                       d_areas=d_areas))
         return per_area
 
     def _accumulate_cell(self, evals, max_det: int) -> np.ndarray:

@@ -23,7 +23,7 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.loader import TestLoader
 from mx_rcnn_tpu.logger import logger
-from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN, forward_rpn, forward_test
+from mx_rcnn_tpu.models.zoo import forward_rpn, forward_test
 from mx_rcnn_tpu.ops.detection import multiclass_nms
 
 
@@ -34,10 +34,11 @@ class Predictor:
     max test shapes); here binding = jit caching per input shape.
     """
 
-    def __init__(self, model: FasterRCNN, params, cfg: Config):
+    def __init__(self, model, params, cfg: Config):
         self.model = model
         self.params = params
         self.cfg = cfg
+        self.use_mask = bool(getattr(model, "use_mask", False))
 
         def _detect(params, image, im_info):
             rois, roi_valid, scores, boxes = forward_test(
@@ -55,14 +56,29 @@ class Predictor:
             # (reference: tester.py im_proposal runs the rpn-test symbol).
             return forward_rpn(model, params, image, im_info, cfg)
 
+        def _masks(params, image, det_boxes, det_classes, det_valid):
+            from mx_rcnn_tpu.models.fpn import forward_test_masks
+
+            return forward_test_masks(model, params, image, det_boxes,
+                                      det_classes, det_valid)
+
         self._detect = jax.jit(_detect)
         self._propose = jax.jit(_propose)
+        self._masks = jax.jit(_masks) if self.use_mask else None
 
     def detect(self, image: np.ndarray, im_info: np.ndarray):
         return self._detect(self.params, jnp.asarray(image), jnp.asarray(im_info))
 
     def propose(self, image: np.ndarray, im_info: np.ndarray):
         return self._propose(self.params, jnp.asarray(image), jnp.asarray(im_info))
+
+    def mask_probs(self, image: np.ndarray, det_boxes: np.ndarray,
+                   det_classes: np.ndarray, det_valid: np.ndarray):
+        """(B, D, m, m) mask probabilities for NETWORK-scale detection boxes
+        (the Mask R-CNN inference tail; see models/fpn.forward_test_masks)."""
+        return self._masks(self.params, jnp.asarray(image),
+                           jnp.asarray(det_boxes), jnp.asarray(det_classes),
+                           jnp.asarray(det_valid))
 
 
 def im_detect(predictor: Predictor, image: np.ndarray, im_info: np.ndarray,
@@ -106,12 +122,19 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
         [np.zeros((0, 5), np.float32) for _ in range(num_images)]
         for _ in range(num_classes)
     ]
+    want_masks = predictor.use_mask
+    all_masks: List[List] = [
+        [[] for _ in range(num_images)] for _ in range(num_classes)
+    ] if want_masks else None
     done = 0
     for batch, metas in test_loader:
         per_image = im_detect(
             predictor, batch["image"], batch["im_info"], metas[0]["scale"])
         if vis:
             _vis_batch(batch, metas, per_image, imdb, test_loader, vis_dir)
+        if want_masks:
+            per_image_rles = _batch_mask_rles(
+                predictor, batch, metas, per_image, test_loader)
         # per-image scales differ; recompute per image (im_detect used the
         # first scale — fix up here for the general batch case).
         for i, meta in enumerate(metas):
@@ -127,13 +150,59 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
                 cls_dets = np.concatenate(
                     [dets[sel, 2:6], dets[sel, 1:2]], axis=1)
                 all_boxes[c][img_idx] = cls_dets.astype(np.float32)
+                if want_masks:
+                    rles = per_image_rles[i]
+                    all_masks[c][img_idx] = [
+                        rles[j] for j in np.nonzero(sel)[0]]
             done += 1
         if done % 100 < len(metas):
             logger.info("im_detect: %d/%d", done, num_images)
     kwargs = {}
     if out_json:
         kwargs["out_json"] = out_json
+    if want_masks and hasattr(imdb, "evaluate_segmentations"):
+        return imdb.evaluate_segmentations(all_boxes, all_masks, **kwargs)
+    if want_masks:
+        logger.warning("%s has no segm evaluation; reporting boxes only",
+                       type(imdb).__name__)
     return imdb.evaluate_detections(all_boxes, **kwargs)
+
+
+def _batch_mask_rles(predictor: Predictor, batch, metas, per_image,
+                     test_loader):
+    """Run the mask head on one batch's final detections and paste to
+    original-size RLEs. Returns per image a list of RLEs aligned with
+    per_image[i]'s det rows."""
+    from mx_rcnn_tpu.masks.paste import paste_masks_to_rles
+
+    d = predictor.cfg.test.max_per_image
+    b = batch["image"].shape[0]
+    det_boxes = np.zeros((b, d, 4), np.float32)
+    det_classes = np.zeros((b, d), np.int32)
+    det_valid = np.zeros((b, d), bool)
+    for i, meta in enumerate(metas):
+        dets = per_image[i]
+        n = min(len(dets), d)
+        # per_image is at ORIGINAL scale (divided by metas[0]); map back to
+        # this image's network-input coords for pooling.
+        det_boxes[i, :n] = dets[:n, 2:6] * metas[0]["scale"]
+        det_classes[i, :n] = dets[:n, 0]
+        det_valid[i, :n] = True
+    probs = np.asarray(predictor.mask_probs(
+        batch["image"], det_boxes, det_classes, det_valid))
+    out = []
+    for i, meta in enumerate(metas):
+        if not meta["real"]:
+            out.append([])
+            continue
+        entry = test_loader.roidb[meta["index"]]
+        h, w = entry["height"], entry["width"]
+        dets = per_image[i]
+        n = min(len(dets), d)
+        # Paste with ORIGINAL-scale boxes (same rows the eval consumes).
+        boxes_orig = dets[:n, 2:6] * (metas[0]["scale"] / meta["scale"])
+        out.append(paste_masks_to_rles(probs[i, :n], boxes_orig, h, w))
+    return out
 
 
 def _vis_batch(batch, metas, per_image, imdb, test_loader, vis_dir):

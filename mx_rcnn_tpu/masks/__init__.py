@@ -15,11 +15,13 @@ from mx_rcnn_tpu.masks.rle import (
     fr_py_objects,
     iou,
     merge,
+    poly_box_frame_mask,
     poly_to_mask,
     to_bbox,
 )
 
 __all__ = [
     "area", "compress", "decode", "decompress", "encode", "fr_bbox",
-    "fr_poly", "fr_py_objects", "iou", "merge", "poly_to_mask", "to_bbox",
+    "fr_poly", "fr_py_objects", "iou", "merge", "poly_box_frame_mask",
+    "poly_to_mask", "to_bbox",
 ]

@@ -1,0 +1,152 @@
+"""ctypes bridge to the C RLE kernels (cc/maskapi.c).
+
+The reference ships its mask engine as C compiled at install time
+(rcnn/pycocotools/setup.py building _mask.pyx + maskApi.c); here the shared
+library is built on first use with the system compiler into
+``cc/build/libmaskapi.so`` and loaded via ctypes (pybind11 is unavailable
+in this environment — SURVEY.md §8). Every entry point degrades to the
+numpy implementation in rle.py when the toolchain or the .so is missing,
+so the native layer is a pure accelerator, never a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "cc", "maskapi.c")
+_SO = os.path.join(_REPO, "cc", "build", "libmaskapi.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    if not os.path.exists(_SRC):
+        return None
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True, capture_output=True, timeout=120)
+            return _SO
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return None
+
+
+def get_lib():
+    """The loaded CDLL, building it if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    path = _SO if os.path.exists(_SO) else _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.rle_encode.restype = ctypes.c_long
+    lib.rle_encode.argtypes = [u8p, ctypes.c_long, u32p]
+    lib.rle_decode.restype = ctypes.c_long
+    lib.rle_decode.argtypes = [u32p, ctypes.c_long, u8p, ctypes.c_long]
+    lib.rle_area.restype = ctypes.c_long
+    lib.rle_area.argtypes = [u32p, ctypes.c_long]
+    lib.rle_merge.restype = ctypes.c_long
+    lib.rle_merge.argtypes = [u32p, ctypes.c_long, u32p, ctypes.c_long,
+                              u32p, ctypes.c_int]
+    lib.rle_iou.restype = None
+    lib.rle_iou.argtypes = [u32p, i64p, i64p, ctypes.c_long,
+                            u32p, i64p, i64p, ctypes.c_long,
+                            u8p, f64p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# -- numpy-facing wrappers (counts as uint32 arrays) ------------------------
+
+
+def encode_counts(mask: np.ndarray) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    flat = np.asfortranarray(mask.astype(np.uint8)).ravel(order="F")
+    flat = np.ascontiguousarray(flat)
+    out = np.empty(flat.size + 1, np.uint32)
+    m = lib.rle_encode(flat, flat.size, out)
+    return out[:m].copy()
+
+
+def decode_counts(counts: np.ndarray, h: int, w: int) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    counts = np.ascontiguousarray(counts, np.uint32)
+    mask = np.empty(h * w, np.uint8)
+    rc = lib.rle_decode(counts, counts.size, mask, mask.size)
+    if rc != 0:
+        raise ValueError(f"RLE length {int(counts.sum())} != h*w {h * w}")
+    return mask.reshape(w, h).T
+
+
+def area_counts(counts: np.ndarray) -> Optional[int]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    counts = np.ascontiguousarray(counts, np.uint32)
+    return int(lib.rle_area(counts, counts.size))
+
+
+def merge_counts(ca: np.ndarray, cb: np.ndarray,
+                 intersect: bool) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    ca = np.ascontiguousarray(ca, np.uint32)
+    cb = np.ascontiguousarray(cb, np.uint32)
+    out = np.empty(ca.size + cb.size + 2, np.uint32)
+    m = lib.rle_merge(ca, ca.size, cb, cb.size, out, int(intersect))
+    return out[:m].copy()
+
+
+def iou_counts(dt: Sequence[np.ndarray], gt: Sequence[np.ndarray],
+               iscrowd: Sequence[bool]) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+
+    def pack(rles: Sequence[np.ndarray]):
+        lens = np.asarray([r.size for r in rles], np.int64)
+        offs = np.zeros(len(rles), np.int64)
+        if len(rles) > 1:
+            offs[1:] = np.cumsum(lens)[:-1]
+        packed = (np.concatenate([np.ascontiguousarray(r, np.uint32)
+                                  for r in rles])
+                  if rles else np.zeros(0, np.uint32))
+        return np.ascontiguousarray(packed), offs, lens
+
+    dp, do, dl = pack(list(dt))
+    gp, go, gl = pack(list(gt))
+    out = np.zeros((len(dt), len(gt)), np.float64)
+    if len(dt) and len(gt):
+        crowd = np.asarray(iscrowd, np.uint8)
+        lib.rle_iou(dp, do, dl, len(dt), gp, go, gl, len(gt), crowd,
+                    out.reshape(-1))
+    return out

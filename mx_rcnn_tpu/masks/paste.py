@@ -1,0 +1,62 @@
+"""Paste predicted ROI masks into full-image binary masks.
+
+The Mask R-CNN inference tail (Detectron lineage, and the reference's
+pycocotools consumers): the head's (m, m) sigmoid probabilities live in the
+detection box's frame; producing a COCO segm result means bilinear-resizing
+them to the box extent, thresholding at 0.5, and writing into an (H, W)
+canvas clipped to the image. Host-side numpy — this feeds json/RLE encoding,
+never the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from mx_rcnn_tpu.masks.rle import RLE, encode
+
+
+def _resize_bilinear_1d(m: int, out: int) -> np.ndarray:
+    """(out, m) bilinear interpolation weights, align_corners=False (the
+    cv2.resize convention Detectron's paste uses)."""
+    if out <= 0:
+        return np.zeros((0, m), np.float64)
+    # Output pixel centres mapped into input coordinates.
+    u = (np.arange(out, dtype=np.float64) + 0.5) * (m / out) - 0.5
+    u = np.clip(u, 0.0, m - 1.0)
+    grid = np.arange(m, dtype=np.float64)
+    return np.maximum(0.0, 1.0 - np.abs(u[:, None] - grid[None, :]))
+
+
+def paste_mask(prob: np.ndarray, box: Sequence[float], h: int, w: int,
+               thresh: float = 0.5) -> np.ndarray:
+    """(m, m) probabilities + inclusive (x1, y1, x2, y2) box → (H, W) uint8.
+
+    The box is rounded outward to whole pixels (floor/ceil) and intersected
+    with the image; the mask is resized to the box size and thresholded.
+    """
+    m = prob.shape[0]
+    x1 = int(np.floor(box[0]))
+    y1 = int(np.floor(box[1]))
+    x2 = int(np.ceil(box[2]))
+    y2 = int(np.ceil(box[3]))
+    bw = max(x2 - x1 + 1, 1)
+    bh = max(y2 - y1 + 1, 1)
+    wy = _resize_bilinear_1d(m, bh)  # (bh, m)
+    wx = _resize_bilinear_1d(m, bw)  # (bw, m)
+    big = wy @ prob.astype(np.float64) @ wx.T  # (bh, bw)
+    canvas = np.zeros((h, w), np.uint8)
+    ix1, iy1 = max(x1, 0), max(y1, 0)
+    ix2, iy2 = min(x2, w - 1), min(y2, h - 1)
+    if ix2 >= ix1 and iy2 >= iy1:
+        crop = big[iy1 - y1:iy2 - y1 + 1, ix1 - x1:ix2 - x1 + 1]
+        canvas[iy1:iy2 + 1, ix1:ix2 + 1] = (crop >= thresh).astype(np.uint8)
+    return canvas
+
+
+def paste_masks_to_rles(probs: np.ndarray, boxes: np.ndarray, h: int, w: int,
+                        thresh: float = 0.5) -> list:
+    """Batch paste_mask + RLE-encode: (N, m, m) + (N, 4) → N compressed RLEs."""
+    return [encode(paste_mask(p, b, h, w, thresh))
+            for p, b in zip(probs, boxes)]

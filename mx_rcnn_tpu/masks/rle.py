@@ -42,19 +42,29 @@ def encode(mask: np.ndarray) -> RLE:
 
     Matches pycocotools.mask.encode for a single mask (pass masks
     individually; the (H, W, N) batched form is a thin loop away).
+    Dispatches to the C kernel (cc/maskapi.c) when built.
     """
+    from mx_rcnn_tpu.masks import _native
+
     h, w = mask.shape
-    flat = np.asfortranarray(mask.astype(bool)).ravel(order="F")
-    return {"size": [int(h), int(w)], "counts": compress(_runs(flat))}
+    counts = _native.encode_counts(mask)
+    if counts is None:
+        flat = np.asfortranarray(mask.astype(bool)).ravel(order="F")
+        counts = _runs(flat)
+    return {"size": [int(h), int(w)], "counts": compress(counts)}
 
 
 def decode(rle: RLE) -> np.ndarray:
     """RLE (compressed or not) -> binary (H, W) uint8 mask."""
+    from mx_rcnn_tpu.masks import _native
+
     h, w = rle["size"]
     counts = _counts(rle)
     total = int(sum(counts))
     if total != h * w:
         raise ValueError(f"RLE length {total} != h*w {h * w}")
+    if _native.available():
+        return _native.decode_counts(np.asarray(counts, np.uint32), h, w)
     flat = np.zeros(h * w, np.uint8)
     pos = 0
     val = 0
@@ -152,16 +162,28 @@ def area(rle: RLE) -> int:
 
 
 def merge(rles: Sequence[RLE], intersect: bool = False) -> RLE:
-    """Union (default) or intersection of masks, all the same size."""
+    """Union (default) or intersection of masks, all the same size.
+
+    With the C kernels, the merge walks run lists directly and never
+    materializes a dense mask (maskApi rleMerge behavior)."""
+    from mx_rcnn_tpu.masks import _native
+
     if not rles:
         raise ValueError("merge of empty list")
     if len(rles) == 1:
         return {"size": list(rles[0]["size"]), "counts": compress(_counts(rles[0]))}
     h, w = rles[0]["size"]
-    acc = decode(rles[0]).astype(bool)
     for r in rles[1:]:
         if list(r["size"]) != [h, w]:
             raise ValueError("merge of differently-sized masks")
+    if _native.available():
+        acc = np.asarray(_counts(rles[0]), np.uint32)
+        for r in rles[1:]:
+            acc = _native.merge_counts(
+                acc, np.asarray(_counts(r), np.uint32), intersect)
+        return {"size": [int(h), int(w)], "counts": compress(acc.tolist())}
+    acc = decode(rles[0]).astype(bool)
+    for r in rles[1:]:
         m = decode(r).astype(bool)
         acc = (acc & m) if intersect else (acc | m)
     return encode(acc)
@@ -173,8 +195,18 @@ def iou(dt: Sequence[RLE], gt: Sequence[RLE],
 
     Crowd semantics (maskApi rleIou): for a crowd gt the denominator is the
     DETECTION's area (i.e. intersection-over-detection), matching the
-    reference's use for ignore regions.
+    reference's use for ignore regions. The C kernel computes intersection
+    areas by run-walking, skipping dense decode entirely.
     """
+    from mx_rcnn_tpu.masks import _native
+
+    if _native.available():
+        res = _native.iou_counts(
+            [np.asarray(_counts(d), np.uint32) for d in dt],
+            [np.asarray(_counts(g), np.uint32) for g in gt],
+            list(iscrowd))
+        if res is not None:
+            return res
     out = np.zeros((len(dt), len(gt)), np.float64)
     dms = [decode(d).astype(bool) for d in dt]
     gms = [decode(g).astype(bool) for g in gt]
@@ -260,6 +292,28 @@ def fr_bbox(bbox: Sequence[float], h: int, w: int) -> RLE:
     y1 = int(np.floor(y + bh + 0.5))
     m[max(y0, 0):max(y1, 0), max(x0, 0):max(x1, 0)] = 1
     return encode(m)
+
+
+def poly_box_frame_mask(polys: Sequence[Sequence[float]],
+                        box: Sequence[float], m: int) -> np.ndarray:
+    """Rasterize polygons into an (m, m) mask over the gt BOX frame.
+
+    This is the storage form the mask-target pipeline uses
+    (targets/mask_targets.py): each instance's mask kept at a fixed
+    resolution over its own box, so ROI targets resample it in-graph. box is
+    (x1, y1, x2, y2) inclusive image coords; polygon coords are image-frame.
+    """
+    x1, y1, x2, y2 = box
+    w = max(float(x2) - float(x1) + 1.0, 1.0)
+    h = max(float(y2) - float(y1) + 1.0, 1.0)
+    out = np.zeros((m, m), bool)
+    for poly in polys:
+        p = np.asarray(poly, np.float64).reshape(-1, 2)
+        q = np.empty_like(p)
+        q[:, 0] = (p[:, 0] - x1) / w * m
+        q[:, 1] = (p[:, 1] - y1) / h * m
+        out |= poly_to_mask(q.ravel().tolist(), m, m).astype(bool)
+    return out.astype(np.uint8)
 
 
 def fr_py_objects(obj, h: int, w: int) -> RLE:

@@ -20,6 +20,14 @@ chosen for TPU:
   The reference uses the tornadomeet v2 pre-act variant; since pretrained
   MXNet checkpoints cannot be loaded in this environment the standard
   detection (Detectron-lineage) block is used and documented here.
+- ``norm="group"`` swaps FrozenBatchNorm for GroupNorm(32) (Wu & He).
+  Frozen BN is only sound when restoring PRETRAINED statistics — the
+  reference always fine-tunes from an ImageNet checkpoint
+  (train_end2end.py --pretrained). Training from scratch (the only option
+  in this offline environment) with identity-initialized frozen BN is
+  numerically unstable; GroupNorm is the batch-independent, SPMD-friendly
+  alternative detection codebases use for from-scratch runs. Default stays
+  "frozen_bn" for reference parity.
 """
 
 from __future__ import annotations
@@ -62,11 +70,22 @@ class FrozenBatchNorm(nn.Module):
         return x * scale.astype(self.dtype) + bias.astype(self.dtype)
 
 
+def make_norm(norm: str, features: int, dtype: Dtype, name: str):
+    """Norm-layer factory: "frozen_bn" (reference parity) or "group"."""
+    if norm == "frozen_bn":
+        return FrozenBatchNorm(features, dtype=dtype, name=name)
+    if norm == "group":
+        return nn.GroupNorm(num_groups=min(32, features), dtype=dtype,
+                            param_dtype=jnp.float32, name=name)
+    raise ValueError(f"unknown norm {norm!r}")
+
+
 class Bottleneck(nn.Module):
     """ResNet v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1, post-activation."""
 
     filters: int  # inner width; output is 4*filters
     stride: int = 1
+    norm: str = "frozen_bn"
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
@@ -75,23 +94,23 @@ class Bottleneck(nn.Module):
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
                     param_dtype=jnp.float32, name="conv1")(x)
-        y = FrozenBatchNorm(self.filters, dtype=self.dtype, name="bn1")(y)
+        y = make_norm(self.norm, self.filters, self.dtype, "bn1")(y)
         y = nn.relu(y)
         y = nn.Conv(self.filters, (3, 3), strides=(self.stride, self.stride),
                     padding=[(1, 1), (1, 1)], use_bias=False, dtype=self.dtype,
                     param_dtype=jnp.float32, name="conv2")(y)
-        y = FrozenBatchNorm(self.filters, dtype=self.dtype, name="bn2")(y)
+        y = make_norm(self.norm, self.filters, self.dtype, "bn2")(y)
         y = nn.relu(y)
         y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype,
                     param_dtype=jnp.float32, name="conv3")(y)
-        y = FrozenBatchNorm(self.filters * 4, dtype=self.dtype, name="bn3")(y)
+        y = make_norm(self.norm, self.filters * 4, self.dtype, "bn3")(y)
         if needs_proj:
             residual = nn.Conv(self.filters * 4, (1, 1),
                                strides=(self.stride, self.stride),
                                use_bias=False, dtype=self.dtype,
                                param_dtype=jnp.float32, name="downsample_conv")(x)
-            residual = FrozenBatchNorm(self.filters * 4, dtype=self.dtype,
-                                       name="downsample_bn")(residual)
+            residual = make_norm(self.norm, self.filters * 4, self.dtype,
+                                 "downsample_bn")(residual)
         return nn.relu(y + residual)
 
 
@@ -99,13 +118,15 @@ class ResNetStage(nn.Module):
     blocks: int
     filters: int
     stride: int
+    norm: str = "frozen_bn"
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         for i in range(self.blocks):
             x = Bottleneck(self.filters, stride=self.stride if i == 0 else 1,
-                           dtype=self.dtype, name=f"block{i}")(x)
+                           norm=self.norm, dtype=self.dtype,
+                           name=f"block{i}")(x)
         return x
 
 
@@ -120,6 +141,7 @@ class ResNetC4(nn.Module):
 
     depth: int = 50
     freeze_at: int = 2  # 0=no freeze, 1=stem, 2=stem+stage1 (reference default)
+    norm: str = "frozen_bn"
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
@@ -129,16 +151,19 @@ class ResNetC4(nn.Module):
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
                     name="conv0")(x)
-        x = FrozenBatchNorm(64, dtype=self.dtype, name="bn0")(x)
+        x = make_norm(self.norm, 64, self.dtype, "bn0")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         if self.freeze_at >= 1:
             x = jax.lax.stop_gradient(x)
-        x = ResNetStage(blocks[0], 64, stride=1, dtype=self.dtype, name="stage1")(x)
+        x = ResNetStage(blocks[0], 64, stride=1, norm=self.norm,
+                        dtype=self.dtype, name="stage1")(x)
         if self.freeze_at >= 2:
             x = jax.lax.stop_gradient(x)
-        x = ResNetStage(blocks[1], 128, stride=2, dtype=self.dtype, name="stage2")(x)
-        x = ResNetStage(blocks[2], 256, stride=2, dtype=self.dtype, name="stage3")(x)
+        x = ResNetStage(blocks[1], 128, stride=2, norm=self.norm,
+                        dtype=self.dtype, name="stage2")(x)
+        x = ResNetStage(blocks[2], 256, stride=2, norm=self.norm,
+                        dtype=self.dtype, name="stage3")(x)
         return x  # (B, H/16, W/16, 1024)
 
 
@@ -150,6 +175,7 @@ class ResNetStages(nn.Module):
 
     depth: int = 50
     freeze_at: int = 2
+    norm: str = "frozen_bn"
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
@@ -159,17 +185,21 @@ class ResNetStages(nn.Module):
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
                     name="conv0")(x)
-        x = FrozenBatchNorm(64, dtype=self.dtype, name="bn0")(x)
+        x = make_norm(self.norm, 64, self.dtype, "bn0")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         if self.freeze_at >= 1:
             x = jax.lax.stop_gradient(x)
-        c2 = ResNetStage(blocks[0], 64, stride=1, dtype=self.dtype, name="stage1")(x)
+        c2 = ResNetStage(blocks[0], 64, stride=1, norm=self.norm,
+                        dtype=self.dtype, name="stage1")(x)
         if self.freeze_at >= 2:
             c2 = jax.lax.stop_gradient(c2)
-        c3 = ResNetStage(blocks[1], 128, stride=2, dtype=self.dtype, name="stage2")(c2)
-        c4 = ResNetStage(blocks[2], 256, stride=2, dtype=self.dtype, name="stage3")(c3)
-        c5 = ResNetStage(blocks[3], 512, stride=2, dtype=self.dtype, name="stage4")(c4)
+        c3 = ResNetStage(blocks[1], 128, stride=2, norm=self.norm,
+                         dtype=self.dtype, name="stage2")(c2)
+        c4 = ResNetStage(blocks[2], 256, stride=2, norm=self.norm,
+                         dtype=self.dtype, name="stage3")(c3)
+        c5 = ResNetStage(blocks[3], 512, stride=2, norm=self.norm,
+                         dtype=self.dtype, name="stage4")(c4)
         return c2, c3, c4, c5
 
 
@@ -183,12 +213,14 @@ class ResNetHead(nn.Module):
     """
 
     depth: int = 50
+    norm: str = "frozen_bn"
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, rois_feat: jnp.ndarray) -> jnp.ndarray:
         blocks = STAGE_BLOCKS[self.depth]
-        x = ResNetStage(blocks[3], 512, stride=2, dtype=self.dtype,
+        x = ResNetStage(blocks[3], 512, stride=2, norm=self.norm,
+                        dtype=self.dtype,
                         name="stage4")(rois_feat.astype(self.dtype))
         return jnp.mean(x, axis=(1, 2))  # (R, 2048)
 

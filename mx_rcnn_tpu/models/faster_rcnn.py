@@ -52,13 +52,17 @@ class FasterRCNN(nn.Module):
     num_anchors: int = 9
     roi_pool_size: int = 14
     roi_pool_type: str = "align"
+    norm: str = "frozen_bn"
+    freeze_at: int = 2
     dtype: Any = jnp.bfloat16
 
     def setup(self):
         if self.backbone.startswith("resnet"):
             depth = int(self.backbone.replace("resnet", ""))
-            self.features = ResNetC4(depth=depth, dtype=self.dtype)
-            self.head = ResNetHead(depth=depth, dtype=self.dtype)
+            self.features = ResNetC4(depth=depth, freeze_at=self.freeze_at,
+                                     norm=self.norm, dtype=self.dtype)
+            self.head = ResNetHead(depth=depth, norm=self.norm,
+                                   dtype=self.dtype)
         elif self.backbone == "vgg":
             self.features = VGGConv(dtype=self.dtype)
             self.head = VGGHead(dtype=self.dtype)
@@ -451,6 +455,8 @@ def build_model(cfg: Config) -> FasterRCNN:
         num_anchors=cfg.network.num_anchors,
         roi_pool_size=cfg.network.roi_pool_size,
         roi_pool_type=cfg.network.roi_pool_type,
+        norm=cfg.network.norm,
+        freeze_at=cfg.network.freeze_at,
         dtype=jnp.dtype(cfg.network.compute_dtype),
     )
 

@@ -1,0 +1,51 @@
+"""Model zoo dispatch — config → (model, init, forwards).
+
+The reference selects its graph builders by name
+(train_end2end.py: ``eval('get_' + args.network + '_train')`` over
+rcnn/symbol/symbol_vgg.py / symbol_resnet.py). Here the config's
+``network.use_fpn`` flag routes between the two model families:
+
+- classic C4 Faster R-CNN (models/faster_rcnn.py): VGG16 / ResNet-50/101
+  stride-16 single-level models — the reference's actual graphs;
+- FPN Faster/Mask R-CNN (models/fpn.py): BASELINE.json configs 3-4.
+
+Every consumer (trainer, Predictor, bench, CLI) goes through these
+functions so the two families stay drop-in interchangeable: the functional
+forwards share their input/output contracts.
+"""
+
+from __future__ import annotations
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models import faster_rcnn as _c4
+from mx_rcnn_tpu.models import fpn as _fpn
+
+
+def build_model(cfg: Config):
+    if cfg.network.use_fpn:
+        return _fpn.build_fpn_model(cfg)
+    return _c4.build_model(cfg)
+
+
+def init_params(model, cfg: Config, rng, image_shape=None):
+    if isinstance(model, _fpn.FPNFasterRCNN):
+        return _fpn.init_fpn_params(model, cfg, rng, image_shape)
+    return _c4.init_params(model, cfg, rng, image_shape)
+
+
+def forward_train(model, params, batch, rng, cfg: Config):
+    if isinstance(model, _fpn.FPNFasterRCNN):
+        return _fpn.forward_train(model, params, batch, rng, cfg)
+    return _c4.forward_train(model, params, batch, rng, cfg)
+
+
+def forward_test(model, params, images, im_info, cfg: Config):
+    if isinstance(model, _fpn.FPNFasterRCNN):
+        return _fpn.forward_test(model, params, images, im_info, cfg)
+    return _c4.forward_test(model, params, images, im_info, cfg)
+
+
+def forward_rpn(model, params, images, im_info, cfg: Config):
+    if isinstance(model, _fpn.FPNFasterRCNN):
+        return _fpn.forward_rpn(model, params, images, im_info, cfg)
+    return _c4.forward_rpn(model, params, images, im_info, cfg)

@@ -1,0 +1,100 @@
+"""Multi-host (multi-process) wiring — the `dist_sync` KVStore analog.
+
+Reference: MXNet KVStore's `dist_sync`/`dist_async` modes run a ps-lite
+C++ parameter server over TCP and every worker pushes/pulls gradients
+(SURVEY.md §6 'distributed communication backend'). The TPU equivalent has
+no server at all: `jax.distributed.initialize` connects the processes,
+every process sees the GLOBAL device set, and the same pjit train step
+compiles to per-host programs whose gradient all-reduce rides ICI within a
+host/slice and DCN across them (mesh data axis leading = cross-host).
+
+Environment contract (the `--kvstore dist_sync` analog):
+  MXRCNN_COORDINATOR   host:port of process 0 (jax coordinator)
+  MXRCNN_NUM_PROCESSES world size
+  MXRCNN_PROCESS_ID    this process's rank
+
+All three set and NUM_PROCESSES > 1 → `maybe_initialize_distributed()`
+connects; otherwise it is a no-op (single-process path unchanged). On TPU
+pods where the cluster environment is auto-detectable, call
+`jax.distributed.initialize()` directly before the entry point instead —
+this helper intentionally only handles the explicit MXRCNN_* contract.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.logger import logger
+
+
+def maybe_initialize_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Connect this process to the jax distributed runtime if configured.
+
+    MUST run before the first jax device/backend use. Returns True when a
+    multi-process runtime was initialized.
+    """
+    coordinator = coordinator or os.environ.get("MXRCNN_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("MXRCNN_NUM_PROCESSES", "0") or 0)
+    if process_id is None:
+        pid_env = os.environ.get("MXRCNN_PROCESS_ID")
+        process_id = int(pid_env) if pid_env is not None else None
+
+    if coordinator and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        logger.info(
+            "distributed: process %d/%d via %s (%d global devices)",
+            jax.process_index(), jax.process_count(), coordinator,
+            jax.device_count())
+        return True
+    return False
+
+
+def is_primary() -> bool:
+    """True on the process that owns logging/checkpoint writes."""
+    return jax.process_index() == 0
+
+
+def local_data_shards(mesh) -> int:
+    """How many data-axis shards THIS process feeds.
+
+    The loader produces `batch_images × local_data_shards` images per step;
+    `shard_batch` assembles them into the global array. Mesh data axis is
+    laid out process-major (create_mesh uses jax.devices() order), so the
+    division is exact.
+    """
+    n_data = mesh.shape["data"]
+    procs = jax.process_count()
+    if n_data % procs:
+        raise ValueError(
+            f"data axis {n_data} not divisible by process count {procs}")
+    return n_data // procs
+
+
+def make_global_batch(batch: Dict[str, np.ndarray], mesh, sharding) -> Dict:
+    """Per-process local batch → global jax.Arrays (multi-host shard_batch).
+
+    Single-process: plain device_put. Multi-process: every process
+    contributes its local leading-axis slice via
+    `jax.make_array_from_process_local_data` — the global batch never
+    materializes on any one host (the ps-lite analog would have shipped it
+    through the server).
+    """
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+    return {
+        k: jax.make_array_from_process_local_data(sharding, np.asarray(v))
+        for k, v in batch.items()
+    }

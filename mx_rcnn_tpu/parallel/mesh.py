@@ -63,7 +63,10 @@ def shard_batch(batch: dict, mesh: Mesh) -> dict:
     The analog of DataParallelExecutorGroup slicing a batch across contexts
     (reference: mxnet executor_group via work_load_list) — here one
     device_put with a NamedSharding; the batch's leading dim must divide by
-    the data-axis size.
+    the data-axis size. Under a multi-process runtime each process passes
+    its LOCAL slice and the global array is assembled across hosts
+    (parallel/distributed.py).
     """
-    sh = batch_sharding(mesh)
-    return {k: jax.device_put(v, sh) for k, v in batch.items()}
+    from mx_rcnn_tpu.parallel.distributed import make_global_batch
+
+    return make_global_batch(batch, mesh, batch_sharding(mesh))

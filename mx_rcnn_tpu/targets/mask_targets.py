@@ -1,0 +1,75 @@
+"""Mask target resampling — box-frame gt masks → per-ROI training targets.
+
+Mask R-CNN (He et al.) supervises the mask head with the gt instance mask
+cropped to each sampled fg ROI and resized to the head's output resolution
+(28x28). The reference lineage does this on the host with polygon
+re-rasterization per ROI (Detectron's segm rasterize); that is a data-
+dependent host loop — the TPU design instead stores each gt instance's mask
+ONCE, rasterized over its own gt box at a fixed `mask_gt_resolution`
+(config, default 56), and resamples it onto ROI frames *inside the jitted
+step* with the same separable tent-weight matmuls as ops/roi_align.py.
+
+Coordinate mapping: gt_masks[g][u, v] covers the gt box uniformly — mask
+cell (u, v) spans gt_y1 + u/M*(gt_h), etc. A target cell (i, j) of an ROI
+samples the point at the cell centre, mapped into the gt mask's continuous
+coordinates; points outside the gt box read 0 (zero-padded sampling).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _resample_weights(lo, size, out_res: int, in_res: int, in_lo, in_size):
+    """(out_res, in_res) bilinear weights sampling an axis of the gt-mask
+    grid at the centres of `out_res` cells spanning [lo, lo+size).
+
+    Gt mask cell u has centre in_lo + (u + 0.5)/in_res * in_size. Sample
+    points outside [in_lo, in_lo+in_size) get zero weight rows (zero-pad).
+    """
+    centers = lo + (jnp.arange(out_res, dtype=jnp.float32) + 0.5) * (
+        size / out_res)
+    # Continuous gt-grid coordinate of each sample (in units of mask cells,
+    # relative to cell centres).
+    u = (centers - in_lo) / jnp.maximum(in_size, 1e-6) * in_res - 0.5
+    grid = jnp.arange(in_res, dtype=jnp.float32)
+    tent = jnp.maximum(0.0, 1.0 - jnp.abs(u[:, None] - grid[None, :]))
+    # Outside the gt box entirely -> all-zero row (instead of clamping).
+    inside = (u > -1.0) & (u < in_res)
+    return tent * inside[:, None]
+
+
+def mask_targets_for_rois(
+    rois: jnp.ndarray,
+    matched_gt: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_masks: jnp.ndarray,
+    *,
+    resolution: int = 28,
+) -> jnp.ndarray:
+    """Per-ROI binary mask targets.
+
+    Args:
+      rois: (R, 4) sampled boxes (image coords).
+      matched_gt: (R,) int32 gt index per roi.
+      gt_boxes: (G, 4); gt_masks: (G, M, M) {0,1} box-frame instance masks.
+      resolution: mask head output size (28).
+
+    Returns: (R, resolution, resolution) float32 in {0, 1}.
+    """
+    m = gt_masks.shape[-1]
+
+    def one_roi(roi, g):
+        gb = gt_boxes[g]
+        gm = gt_masks[g].astype(jnp.float32)  # (M, M)
+        gw = gb[2] - gb[0] + 1.0
+        gh = gb[3] - gb[1] + 1.0
+        rw = jnp.maximum(roi[2] - roi[0] + 1.0, 1.0)
+        rh = jnp.maximum(roi[3] - roi[1] + 1.0, 1.0)
+        wy = _resample_weights(roi[1], rh, resolution, m, gb[1], gh)
+        wx = _resample_weights(roi[0], rw, resolution, m, gb[0], gw)
+        sampled = wy @ gm @ wx.T  # (res, res)
+        return (sampled >= 0.5).astype(jnp.float32)
+
+    return jax.vmap(one_roi)(rois, matched_gt)

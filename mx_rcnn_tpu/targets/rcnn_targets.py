@@ -35,6 +35,10 @@ class RoiSamples(NamedTuple):
     bbox_weights: jnp.ndarray  # (R, 4*num_classes)
     valid: jnp.ndarray         # (R,) bool — False only in degenerate cases
     fg_mask: jnp.ndarray       # (R,) bool
+    matched_gt: jnp.ndarray    # (R,) int32 index into the gt arrays
+    # (meaningful on fg slots only — the mask head resamples
+    # gt_masks[matched_gt]; the reference has no analog because its
+    # ProposalTarget recomputes matches on the host.)
 
 
 def _ranked_candidates(mask: jnp.ndarray, key) -> tuple:
@@ -132,4 +136,5 @@ def sample_rois(
         .astype(jnp.float32),
         valid=slot_valid,
         fg_mask=fg_mask,
+        matched_gt=matched.astype(jnp.int32),
     )

@@ -113,9 +113,11 @@ def train_rcnn(cfg: Config, prefix: str, rpn_file: str,
     )
 
 
-def _roiiter_factory(roidb, cfg, num_shards, max_proposals=2000, seed=0):
+def _roiiter_factory(roidb, cfg, num_shards, max_proposals=2000, seed=0,
+                     process_count=1, process_index=0):
     return ROIIter(roidb, cfg, num_shards, max_proposals=max_proposals,
-                   seed=seed)
+                   seed=seed, process_count=process_count,
+                   process_index=process_index)
 
 
 def test_rcnn(cfg: Config, prefix: str, epoch: int,

@@ -18,7 +18,7 @@ from mx_rcnn_tpu.data.datasets import get_dataset
 from mx_rcnn_tpu.data.datasets.imdb import filter_roidb, merge_roidb
 from mx_rcnn_tpu.data.loader import AnchorLoader
 from mx_rcnn_tpu.logger import logger
-from mx_rcnn_tpu.models.faster_rcnn import build_model, init_params
+from mx_rcnn_tpu.models.zoo import build_model, forward_train, init_params
 from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
 from mx_rcnn_tpu.train.callback import Speedometer
 from mx_rcnn_tpu.train.checkpoint import (
@@ -72,11 +72,16 @@ def fit_detector(
     fixed_param_patterns extends the frozen set (alternate stages 4/6 freeze
     the shared conv trunk — reference train_alternate.py).
     """
+    from mx_rcnn_tpu.parallel.distributed import is_primary, local_data_shards
+
     end_epoch = end_epoch or cfg.train.end_epoch
     mesh = create_mesh(mesh_spec or cfg.mesh.mesh_shape)
     n_data = mesh.shape["data"]
-    logger.info("mesh: %s (data=%d model=%d)", mesh.devices.shape,
-                n_data, mesh.shape["model"])
+    # Each process feeds only its own slice of the data axis (multi-host:
+    # parallel/distributed.py; single-process: n_local == n_data).
+    n_local = local_data_shards(mesh)
+    logger.info("mesh: %s (data=%d model=%d, %d local shards)",
+                mesh.devices.shape, n_data, mesh.shape["model"], n_local)
 
     if fixed_param_patterns is not None:
         from dataclasses import replace as _replace
@@ -89,9 +94,25 @@ def fit_detector(
     params = pretrained_params or init_params(
         model, cfg, jax.random.PRNGKey(seed))
     if loader_factory is None:
-        loader = AnchorLoader(roidb, cfg, num_shards=n_data, seed=seed)
+        loader = AnchorLoader(roidb, cfg, num_shards=n_local, seed=seed,
+                              process_count=jax.process_count(),
+                              process_index=jax.process_index())
     else:
-        loader = loader_factory(roidb, cfg, n_data)
+        import inspect
+
+        params_of = inspect.signature(loader_factory).parameters
+        if "process_count" in params_of or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params_of.values()):
+            loader = loader_factory(roidb, cfg, n_local,
+                                    process_count=jax.process_count(),
+                                    process_index=jax.process_index())
+        else:
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "loader_factory must accept process_count/process_index "
+                    "kwargs to run multi-host")
+            loader = loader_factory(roidb, cfg, n_local)
     steps_per_epoch = max(len(loader), 1)
 
     # Resume discovery BEFORE building the optimizer: a restored opt_state
@@ -126,7 +147,6 @@ def fit_detector(
             step=jax.numpy.asarray(begin_epoch * steps_per_epoch,
                                    jax.numpy.int32))
 
-    from mx_rcnn_tpu.models.faster_rcnn import forward_train
     step_fn = make_train_step(model, cfg, mesh=mesh,
                               forward_fn=forward_fn or forward_train)
     rng = jax.random.PRNGKey(seed + 1)
@@ -141,10 +161,11 @@ def fit_detector(
             bag.update(metrics)
             speedometer(epoch, i, bag)
         logger.info("Epoch[%d] done. %s", epoch, bag.format())
-        save_checkpoint(
-            prefix, epoch + 1, state.params, state.opt_state,
-            means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
-            num_classes=cfg.dataset.num_classes)
+        if is_primary():  # multi-host: one writer (params are replicated)
+            save_checkpoint(
+                prefix, epoch + 1, state.params, state.opt_state,
+                means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+                num_classes=cfg.dataset.num_classes)
         if epoch_callback:
-            epoch_callback(epoch, state)
+            epoch_callback(epoch, state, bag)
     return jax.device_get(state.params)

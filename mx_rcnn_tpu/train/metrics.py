@@ -17,6 +17,7 @@ from typing import Dict, Iterable
 METRIC_NAMES = (
     "RPNAcc", "RPNLogLoss", "RPNL1Loss",
     "RCNNAcc", "RCNNLogLoss", "RCNNL1Loss",
+    "TotalLoss",  # not one of the reference's 6 — kept for the epoch log
 )
 
 

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Mask R-CNN R-50-FPN on COCO instance segmentation — BASELINE.json config 4.
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+python train_end2end.py \
+  --network resnet50_fpn_mask --dataset coco --image_set train2017 \
+  --prefix model/mask_r50_fpn_coco --end_epoch 8 --lr 0.00125 --lr_step 6 \
+  --tpu-mesh "${TPU_MESH:-8}" "$@"
+
+python test.py \
+  --network resnet50_fpn_mask --dataset coco --image_set val2017 \
+  --prefix model/mask_r50_fpn_coco --epoch 8 \
+  --out_json results/mask_r50_fpn_coco_dets.json

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# ResNet-101 Faster R-CNN e2e on COCO train2017 — the BASELINE.json
+# flagship C4 config. Expected ~26-27 box mAP@[.5:.95] (BASELINE.md).
+# 8-way DP over one v5e host: TPU_MESH=8.
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+python train_end2end.py \
+  --network resnet101 --dataset coco --image_set train2017 \
+  --prefix model/r101_coco_e2e --end_epoch 8 --lr 0.00125 --lr_step 6 \
+  --tpu-mesh "${TPU_MESH:-8}" "$@"
+
+python test.py \
+  --network resnet101 --dataset coco --image_set val2017 \
+  --prefix model/r101_coco_e2e --epoch 8 \
+  --out_json results/r101_coco_dets.json

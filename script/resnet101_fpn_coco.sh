@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# ResNet-101-FPN Faster R-CNN e2e on COCO — BASELINE.json config 3
+# (multi-scale FPN, 8-way DP).
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+python train_end2end.py \
+  --network resnet101_fpn --dataset coco --image_set train2017 \
+  --prefix model/r101_fpn_coco --end_epoch 8 --lr 0.00125 --lr_step 6 \
+  --tpu-mesh "${TPU_MESH:-8}" "$@"
+
+python test.py \
+  --network resnet101_fpn --dataset coco --image_set val2017 \
+  --prefix model/r101_fpn_coco --epoch 8 \
+  --out_json results/r101_fpn_coco_dets.json

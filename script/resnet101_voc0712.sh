@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# ResNet-101 Faster R-CNN e2e on VOC07+12, eval on VOC07 test.
+# Reference recipe analog: script/resnet_voc0712.sh. Expected ~79 mAP@0.5.
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+python train_end2end.py \
+  --network resnet101 --dataset PascalVOC \
+  --image_set 2007_trainval+2012_trainval \
+  --prefix model/r101_voc0712_e2e --end_epoch 10 --lr 0.001 --lr_step 7 \
+  --tpu-mesh "${TPU_MESH:-1}" "$@"
+
+python test.py \
+  --network resnet101 --dataset PascalVOC --image_set 2007_test \
+  --prefix model/r101_voc0712_e2e --epoch 10

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# VGG-16 Faster R-CNN end-to-end on VOC07 trainval, eval on VOC07 test.
+# Reference recipe analog: script/vgg_voc07.sh (train_end2end then test).
+# Expected: ~70 mAP@0.5 (BASELINE.md row 1) after 10 epochs.
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+python train_end2end.py \
+  --network vgg --dataset PascalVOC --image_set 2007_trainval \
+  --prefix model/vgg_voc07_e2e --end_epoch 10 --lr 0.001 --lr_step 7 \
+  --tpu-mesh "${TPU_MESH:-1}" "$@"
+
+python test.py \
+  --network vgg --dataset PascalVOC --image_set 2007_test \
+  --prefix model/vgg_voc07_e2e --epoch 10

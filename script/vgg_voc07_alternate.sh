@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# VGG-16 Faster R-CNN 4-stage alternate optimization on VOC07 (Ren et al.).
+# Reference recipe analog: script/vgg_alter_voc07.sh.
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+python train_alternate.py \
+  --network vgg --dataset PascalVOC --image_set 2007_trainval \
+  --prefix model/vgg_voc07_alt --rpn_epoch 8 --rcnn_epoch 8 \
+  --tpu-mesh "${TPU_MESH:-1}" "$@"
+
+python test.py \
+  --network vgg --dataset PascalVOC --image_set 2007_test \
+  --prefix model/vgg_voc07_alt --epoch 8

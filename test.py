@@ -15,7 +15,7 @@ from mx_rcnn_tpu.data.datasets import get_dataset
 from mx_rcnn_tpu.data.loader import TestLoader
 from mx_rcnn_tpu.evaluation.tester import Predictor, pred_eval
 from mx_rcnn_tpu.logger import logger
-from mx_rcnn_tpu.models.faster_rcnn import build_model, init_params
+from mx_rcnn_tpu.models.zoo import build_model, init_params
 from mx_rcnn_tpu.train.checkpoint import load_checkpoint
 
 
@@ -33,6 +33,9 @@ def parse_args():
     p.add_argument("--vis", action="store_true")
     p.add_argument("--out_json", default=None,
                    help="write COCO-format detections json")
+    p.add_argument("--from-scratch", dest="from_scratch", action="store_true",
+                   help="match a train_end2end.py --from-scratch checkpoint "
+                        "(GroupNorm backbone)")
     return p.parse_args()
 
 
@@ -43,6 +46,9 @@ def main():
         overrides["dataset.root_path"] = args.root_path
     if args.dataset_path:
         overrides["dataset.dataset_path"] = args.dataset_path
+    if args.from_scratch:
+        overrides["network.norm"] = "group"
+        overrides["network.freeze_at"] = 0
     cfg = generate_config(args.network, args.dataset, **overrides)
     image_set = args.image_set or cfg.dataset.test_image_set
 

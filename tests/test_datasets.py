@@ -1,0 +1,262 @@
+"""PascalVOC / COCODataset against the checked-in 2-image fixtures.
+
+VERDICT round 1 flagged both dataset classes as never-executed (offline, no
+data); tests/fixtures/mini_voc and mini_coco are tiny but REAL on-disk
+datasets (actual JPEGs, VOC XML, COCO instances json incl. a crowd-RLE
+annotation) so the parse → roidb → loader → eval paths run in CI.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data.datasets.coco import COCODataset
+from mx_rcnn_tpu.data.datasets.pascal_voc import PascalVOC
+from mx_rcnn_tpu.data.loader import AnchorLoader
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+VOC_ROOT = os.path.join(FIXTURES, "mini_voc/VOCdevkit")
+COCO_ROOT = os.path.join(FIXTURES, "mini_coco")
+
+
+# ---------------------------------------------------------------------------
+# PASCAL VOC
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def voc():
+    return PascalVOC("2007_minitest", root_path=FIXTURES,
+                     dataset_path=VOC_ROOT)
+
+
+def test_voc_index_and_roidb(voc):
+    assert voc.image_index == ["000001", "000002"]
+    roidb = voc._load_gt_roidb()
+    assert len(roidb) == 2
+    e1 = roidb[0]
+    # Difficult person and non-VOC class are excluded from training boxes;
+    # the dog stays, converted to 0-indexed coords.
+    assert e1["boxes"].shape == (1, 4)
+    np.testing.assert_allclose(e1["boxes"][0], [10, 8, 40, 38])
+    assert voc.classes[e1["gt_classes"][0]] == "dog"
+    # ... but kept for evaluation (difficult handling); the non-VOC class is
+    # dropped entirely at parse.
+    assert e1["all_boxes"].shape == (2, 4)
+    assert e1["difficult"].tolist() == [False, True]
+    assert e1["height"] == 48 and e1["width"] == 64
+
+
+def test_voc_loader_reads_real_jpegs(voc):
+    cfg = generate_config("resnet50", "PascalVOC", **{
+        "image.pad_shape": (64, 64), "image.scales": ((48, 64),),
+        "train.max_gt_boxes": 4, "train.flip": False,
+    })
+    roidb = voc._load_gt_roidb()
+    loader = AnchorLoader(roidb, cfg, num_shards=1, shuffle=False, seed=0)
+    batch = next(iter(loader))
+    assert batch["image"].shape == (1, 64, 64, 3)
+    assert batch["gt_valid"][0].sum() == 1
+    # The dog rectangle is red-ish: the mean-subtracted red channel inside
+    # the box must exceed the background's.
+    img = batch["image"][0]
+    assert img[20, 20, 0] > img[45, 2, 0]
+
+
+def test_voc_eval_perfect_detections(voc, tmp_path):
+    roidb = voc._load_gt_roidb()
+    n = len(roidb)
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(n)]
+                 for _ in range(voc.num_classes)]
+    dog = voc.classes.index("dog")
+    cat = voc.classes.index("cat")
+    all_boxes[dog][0] = np.asarray([[10, 8, 40, 38, 0.9]], np.float32)
+    all_boxes[cat][1] = np.asarray([[5, 5, 30, 30, 0.8]], np.float32)
+    result = voc.evaluate_detections(all_boxes)
+    assert result["dog"] == pytest.approx(1.0, abs=1e-4)
+    assert result["cat"] == pytest.approx(1.0, abs=1e-4)
+    # comp4 result files round-trip (reference write_pascal_results).
+    voc.write_results(all_boxes, str(tmp_path))
+    path = tmp_path / "comp4_det_minitest_dog.txt"
+    assert path.exists()
+    line = path.read_text().strip().split()
+    assert line[0] == "000001" and float(line[2]) == 11.0  # 1-indexed
+
+
+def test_voc_eval_difficult_not_counted(voc):
+    """A detection on the difficult person neither scores nor hurts."""
+    roidb = voc._load_gt_roidb()
+    n = len(roidb)
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(n)]
+                 for _ in range(voc.num_classes)]
+    person = voc.classes.index("person")
+    all_boxes[person][0] = np.asarray([[45, 5, 58, 42, 0.95]], np.float32)
+    result = voc.evaluate_detections(all_boxes)
+    # No non-difficult person gt anywhere: AP must be 0 (not negative /
+    # crash), and the det must have been IGNORED rather than counted FP.
+    assert result["person"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# COCO
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def coco():
+    return COCODataset("minival", root_path=FIXTURES,
+                       dataset_path=COCO_ROOT)
+
+
+def test_coco_roidb(coco):
+    roidb = coco._load_gt_roidb()
+    assert coco.classes == ("__background__", "car", "dog")
+    assert len(roidb) == 2
+    e1, e2 = roidb
+    # Crowd annotation excluded from training boxes.
+    assert e1["boxes"].shape == (1, 4)
+    np.testing.assert_allclose(e1["boxes"][0], [10, 10, 40, 40])
+    assert e1["gt_classes"][0] == 1  # car → contiguous id 1 (cat id 3)
+    # Out-of-bounds bbox is clipped into the image.
+    assert e2["boxes"].shape == (2, 4)
+    np.testing.assert_allclose(e2["boxes"][1], [0, 0, 6, 5])
+    # Polygon segmentations ride along for the mask pipeline.
+    assert e1["segmentations"][0] is not None
+    assert len(e1["segmentations"]) == 1
+
+
+def test_coco_loader_with_masks(coco):
+    cfg = generate_config("resnet50_fpn_mask", "coco", **{
+        "image.pad_shape": (64, 64), "image.scales": ((48, 64),),
+        "train.max_gt_boxes": 4, "train.flip": False,
+        "train.mask_gt_resolution": 28,
+    })
+    roidb = coco._load_gt_roidb()
+    loader = AnchorLoader(roidb, cfg, num_shards=1, shuffle=False, seed=0)
+    batches = list(loader)
+    assert len(batches) == 2
+    b = batches[0]
+    assert b["gt_masks"].shape == (1, 4, 28, 28)
+    # The car's polygon fills its whole box → its box-frame mask is ~all on.
+    assert b["gt_masks"][0, 0].mean() > 0.9
+    # Padding gt slots carry empty masks.
+    assert b["gt_masks"][0, 3].sum() == 0
+
+
+def test_coco_eval_perfect_detections(coco, tmp_path):
+    roidb = coco._load_gt_roidb()
+    n = len(roidb)
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(n)]
+                 for _ in range(coco.num_classes)]
+    # Perfect detections for all three non-crowd gts. Note the third matches
+    # the ORIGINAL (unclipped) annotation bbox — COCO eval compares against
+    # the json annotations, not the training-clipped roidb boxes.
+    all_boxes[1][0] = np.asarray([[10, 10, 40, 40, 0.9]], np.float32)
+    all_boxes[2][1] = np.asarray([[5, 20, 30, 55, 0.8]], np.float32)
+    all_boxes[1][1] = np.asarray([[-3, -2, 6, 5, 0.7]], np.float32)
+    out_json = str(tmp_path / "dets.json")
+    stats = coco.evaluate_detections(all_boxes, out_json=out_json)
+    assert stats["AP"] == pytest.approx(1.0, abs=1e-3), stats
+    assert os.path.exists(out_json)
+
+
+def test_coco_eval_false_positive_lowers_ap(coco):
+    roidb = coco._load_gt_roidb()
+    n = len(roidb)
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(n)]
+                 for _ in range(coco.num_classes)]
+    all_boxes[1][0] = np.asarray(
+        [[10, 10, 40, 40, 0.9],
+         [50, 2, 62, 12, 0.95]],  # confident FP in open space
+        np.float32)
+    all_boxes[2][1] = np.asarray([[5, 20, 30, 55, 0.8]], np.float32)
+    all_boxes[1][1] = np.asarray([[0, 0, 6, 5, 0.7]], np.float32)
+    stats = coco.evaluate_detections(all_boxes)
+    assert stats["AP"] < 1.0
+
+
+def test_coco_crowd_region_detection_ignored(coco):
+    """A detection inside the crowd-RLE region must be IGNORED (matched to
+    the crowd gt), not counted as a false positive — the maskApi crowd-IoU
+    semantics flowing through eval."""
+    roidb = coco._load_gt_roidb()
+    n = len(roidb)
+
+    def boxes_with_crowd_hit():
+        all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(n)]
+                     for _ in range(coco.num_classes)]
+        all_boxes[1][0] = np.asarray([[10, 10, 40, 40, 0.9]], np.float32)
+        all_boxes[2][1] = np.asarray([[5, 20, 30, 55, 0.8]], np.float32)
+        all_boxes[1][1] = np.asarray([[-3, -2, 6, 5, 0.7]], np.float32)
+        # dog detection fully inside the crowd block (0,30)-(19,41) @img1
+        all_boxes[2][0] = np.asarray([[2, 31, 17, 40, 0.85]], np.float32)
+        return all_boxes
+
+    stats = coco.evaluate_detections(boxes_with_crowd_hit())
+    assert stats["AP"] == pytest.approx(1.0, abs=1e-3), stats
+
+
+def test_coco_segm_eval_perfect_masks(coco, tmp_path):
+    """evaluate_segmentations with pixel-perfect masks -> segm AP == 1."""
+    from mx_rcnn_tpu import masks as M
+
+    roidb = coco._load_gt_roidb()
+    n = len(roidb)
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(n)]
+                 for _ in range(coco.num_classes)]
+    all_masks = [[[] for _ in range(n)] for _ in range(coco.num_classes)]
+
+    def full_mask(poly, h, w):
+        return M.fr_poly(poly, h, w)
+
+    # img1 car: polygon rectangle (10,10)-(41,41) @ 48x64
+    all_boxes[1][0] = np.asarray([[10, 10, 40, 40, 0.9]], np.float32)
+    all_masks[1][0] = [full_mask(
+        [[10.0, 10.0, 41.0, 10.0, 41.0, 41.0, 10.0, 41.0]], 48, 64)]
+    # img2 dog: rectangle (5,20)-(31,56) @ 64x48
+    all_boxes[2][1] = np.asarray([[5, 20, 30, 55, 0.8]], np.float32)
+    all_masks[2][1] = [full_mask(
+        [[5.0, 20.0, 31.0, 20.0, 31.0, 56.0, 5.0, 56.0]], 64, 48)]
+    # img2 car: clipped corner box
+    all_boxes[1][1] = np.asarray([[-3, -2, 6, 5, 0.7]], np.float32)
+    all_masks[1][1] = [full_mask(
+        [[0.0, 0.0, 6.0, 0.0, 6.0, 5.0, 0.0, 5.0]], 64, 48)]
+
+    out_json = str(tmp_path / "segm.json")
+    stats = coco.evaluate_segmentations(all_boxes, all_masks,
+                                        out_json=out_json)
+    assert stats["segm_AP"] == pytest.approx(1.0, abs=1e-3), stats
+    assert stats["AP"] > 0.7  # bbox side still evaluated
+    assert os.path.exists(out_json)
+    # The written json is valid COCO segm results.
+    import json as _json
+    with open(out_json) as f:
+        res = _json.load(f)
+    assert all("segmentation" in r and "counts" in r["segmentation"]
+               for r in res)
+
+
+def test_coco_segm_eval_wrong_masks_score_low(coco):
+    """Right boxes, wrong masks: bbox AP stays high, segm AP collapses."""
+    from mx_rcnn_tpu import masks as M
+
+    roidb = coco._load_gt_roidb()
+    n = len(roidb)
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(n)]
+                 for _ in range(coco.num_classes)]
+    all_masks = [[[] for _ in range(n)] for _ in range(coco.num_classes)]
+    # Perfect boxes but masks covering only a sliver of each gt.
+    sliver1 = np.zeros((48, 64), np.uint8); sliver1[10:12, 10:12] = 1
+    sliver2 = np.zeros((64, 48), np.uint8); sliver2[20:22, 5:7] = 1
+    sliver3 = np.zeros((64, 48), np.uint8); sliver3[0:1, 0:1] = 1
+    all_boxes[1][0] = np.asarray([[10, 10, 40, 40, 0.9]], np.float32)
+    all_masks[1][0] = [M.encode(sliver1)]
+    all_boxes[2][1] = np.asarray([[5, 20, 30, 55, 0.8]], np.float32)
+    all_masks[2][1] = [M.encode(sliver2)]
+    all_boxes[1][1] = np.asarray([[-3, -2, 6, 5, 0.7]], np.float32)
+    all_masks[1][1] = [M.encode(sliver3)]
+    stats = coco.evaluate_segmentations(all_boxes, all_masks)
+    assert stats["segm_AP"] < 0.2, stats
+    assert stats["AP"] > 0.7

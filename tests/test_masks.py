@@ -137,3 +137,89 @@ def test_fr_py_objects_dispatch():
 def test_decode_rejects_bad_length():
     with pytest.raises(ValueError):
         masks.decode({"size": [2, 2], "counts": [1, 1]})
+
+
+class TestPaste:
+    def test_paste_identity_box(self):
+        from mx_rcnn_tpu.masks.paste import paste_mask
+
+        prob = np.zeros((4, 4), np.float32)
+        prob[1:3, 1:3] = 1.0
+        # Box covering exactly an 8x8 region: the 4x4 mask upsamples 2x.
+        out = paste_mask(prob, [4, 4, 11, 11], 16, 16)
+        assert out.shape == (16, 16)
+        # Centre of the on-region maps to pixels ~(4+2*1.5 .. 4+2*2.5).
+        assert out[8, 8] == 1 and out[9, 9] == 1
+        assert out[4, 4] == 0 and out[12, 12] == 0
+        # Nothing outside the box.
+        assert out[:4].sum() == 0 and out[:, :4].sum() == 0
+
+    def test_paste_clips_to_image(self):
+        from mx_rcnn_tpu.masks.paste import paste_mask
+
+        prob = np.ones((4, 4), np.float32)
+        out = paste_mask(prob, [-5, -5, 4, 4], 8, 8)
+        assert out.shape == (8, 8)
+        assert out[:5, :5].all()
+        assert out[6:, 6:].sum() == 0
+
+    def test_paste_rles_roundtrip(self):
+        from mx_rcnn_tpu.masks.paste import paste_masks_to_rles
+
+        probs = np.ones((2, 4, 4), np.float32)
+        boxes = np.asarray([[0, 0, 3, 3], [4, 4, 7, 7]], np.float32)
+        rles = paste_masks_to_rles(probs, boxes, 8, 8)
+        m0 = masks.decode(rles[0])
+        m1 = masks.decode(rles[1])
+        assert m0[:4, :4].all() and m0.sum() == 16
+        assert m1[4:, 4:].all() and m1.sum() == 16
+
+
+class TestNativeKernels:
+    """Differential: C kernels (cc/maskapi.c via ctypes) vs the numpy layer."""
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        from mx_rcnn_tpu.masks import _native
+        if not _native.available():
+            pytest.skip("C toolchain unavailable; numpy fallback covered "
+                        "by the other tests")
+
+    def test_encode_decode_matches_numpy(self):
+        from mx_rcnn_tpu.masks import _native
+        rs = np.random.RandomState(1)
+        for shape in [(13, 7), (1, 1), (5, 40), (64, 48)]:
+            m = (rs.rand(*shape) > 0.5).astype(np.uint8)
+            counts = _native.encode_counts(m)
+            flat = np.asfortranarray(m.astype(bool)).ravel(order="F")
+            from mx_rcnn_tpu.masks.rle import _runs
+            assert counts.tolist() == _runs(flat)
+            back = _native.decode_counts(counts, *shape)
+            assert np.array_equal(back, m)
+
+    def test_merge_iou_match_dense(self):
+        from mx_rcnn_tpu.masks import _native
+        rs = np.random.RandomState(2)
+        a = (rs.rand(20, 15) > 0.6).astype(np.uint8)
+        b = (rs.rand(20, 15) > 0.4).astype(np.uint8)
+        ca = _native.encode_counts(a)
+        cb = _native.encode_counts(b)
+        for intersect in (False, True):
+            got = _native.decode_counts(
+                _native.merge_counts(ca, cb, intersect), 20, 15)
+            want = (a & b) if intersect else (a | b)
+            assert np.array_equal(got, want.astype(np.uint8))
+        got_iou = _native.iou_counts([ca], [cb], [False])[0, 0]
+        inter = np.logical_and(a, b).sum()
+        union = np.logical_or(a, b).sum()
+        assert got_iou == pytest.approx(inter / union)
+        crowd_iou = _native.iou_counts([ca], [cb], [True])[0, 0]
+        assert crowd_iou == pytest.approx(inter / a.sum())
+
+    def test_public_api_uses_native(self):
+        # The dispatching public functions must agree with hand checks when
+        # native is on (same assertions as the numpy tests above them).
+        rs = np.random.RandomState(3)
+        m = (rs.rand(9, 9) > 0.5).astype(np.uint8)
+        assert np.array_equal(masks.decode(masks.encode(m)), m)
+        assert masks.area(masks.encode(m)) == int(m.sum())

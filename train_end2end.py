@@ -44,10 +44,19 @@ def parse_args():
     p.add_argument("--lr_step", default=None, help="e.g. '7' or '5,7'")
     p.add_argument("--tpu-mesh", "--gpus", dest="tpu_mesh", default="",
                    help="mesh shape: '8' or '4x2' (replaces --gpus)")
+    p.add_argument("--from-scratch", dest="from_scratch", action="store_true",
+                   help="no pretrained weights: GroupNorm backbone, no "
+                        "frozen prefix (frozen-BN with identity statistics "
+                        "is unstable — see models/backbones.py). The "
+                        "matching test.py run needs the same flag.")
     return p.parse_args()
 
 
 def main():
+    # Multi-host (dist_sync analog): connect BEFORE any jax device use.
+    from mx_rcnn_tpu.parallel.distributed import maybe_initialize_distributed
+    maybe_initialize_distributed()
+
     args = parse_args()
     overrides = {}
     if args.image_set:
@@ -67,6 +76,9 @@ def main():
             int(s) for s in args.lr_step.split(","))
     if args.end_epoch:
         overrides["train.end_epoch"] = args.end_epoch
+    if args.from_scratch:
+        overrides["network.norm"] = "group"
+        overrides["network.freeze_at"] = 0
     cfg = generate_config(args.network, args.dataset, **overrides)
     logger.info("config: network=%s dataset=%s", args.network, args.dataset)
 
