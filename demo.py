@@ -33,12 +33,19 @@ def parse_args():
     p.add_argument("--image", default=None)
     p.add_argument("--out", default="demo_out.jpg")
     p.add_argument("--thresh", type=float, default=0.5)
+    p.add_argument("--from-scratch", dest="from_scratch", action="store_true",
+                   help="match a train_end2end.py --from-scratch checkpoint "
+                        "(GroupNorm backbone)")
     return p.parse_args()
 
 
 def main():
     args = parse_args()
-    cfg = generate_config(args.network, args.dataset)
+    overrides = {}
+    if args.from_scratch:
+        overrides["network.norm"] = "group"
+        overrides["network.freeze_at"] = 0
+    cfg = generate_config(args.network, args.dataset, **overrides)
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0))
     if args.prefix:

@@ -55,6 +55,23 @@ class NetworkConfig:
     use_mask: bool = False
     mask_pool_size: int = 14
     mask_resolution: int = 28
+    # ViTDet (stretch config; models/vit.py).
+    use_vit: bool = False
+    vit_patch: int = 16
+    vit_dim: int = 768
+    vit_depth: int = 12
+    vit_heads: int = 12
+    vit_window: int = 8  # local-attention window (tokens per side)
+    # Ring attention for the global blocks (sequence-parallel long context,
+    # ops/ring_attention.py); needs a mesh at model build time.
+    use_ring_attention: bool = False
+    # DETR (stretch config; models/detr.py).
+    use_detr: bool = False
+    detr_queries: int = 100
+    detr_hidden: int = 256
+    detr_heads: int = 8
+    detr_enc_layers: int = 6
+    detr_dec_layers: int = 6
 
     @property
     def num_anchors(self) -> int:
@@ -113,6 +130,11 @@ class TrainConfig:
     mask_gt_resolution: int = 56
     # Loss scaling constants (reference scales smooth-L1 by 1/RPN_BATCH and
     # 1/BATCH_ROIS via grad_scale, NOT by live fg counts).
+    # DETR set-loss knobs (models/detr.py; Carion et al. defaults).
+    detr_eos_coef: float = 0.1
+    detr_cost_class: float = 1.0
+    detr_cost_l1: float = 5.0
+    detr_cost_giou: float = 2.0
     # end2end switch retained for the alternate-training tools.
     end2end: bool = True
 
@@ -225,6 +247,18 @@ _NETWORK_PRESETS: Mapping[str, Mapping[str, Any]] = {
         name="resnet101_fpn_mask", depth=101, use_fpn=True, roi_pool_size=7,
         anchor_scales=(8,), use_mask=True,
     ),
+    "vitdet_b": dict(
+        name="vitdet_b", use_vit=True, roi_pool_size=7, anchor_scales=(8,),
+        vit_dim=768, vit_depth=12, vit_heads=12, vit_window=8,
+        norm="group",  # detector-side norms; the ViT itself uses LayerNorm
+    ),
+    "vitdet_b_mask": dict(
+        name="vitdet_b_mask", use_vit=True, roi_pool_size=7,
+        anchor_scales=(8,), use_mask=True,
+        vit_dim=768, vit_depth=12, vit_heads=12, vit_window=8,
+        norm="group",
+    ),
+    "detr_r50": dict(name="detr_r50", depth=50, use_detr=True),
 }
 
 VOC_CLASSES = (

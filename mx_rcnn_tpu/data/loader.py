@@ -209,6 +209,12 @@ class AnchorLoader:
             self._rng.shuffle(horz)
             self._rng.shuffle(vert)
             inds = np.hstack([horz, vert])
+            # Rotate by a random offset so the trimmed epoch tail (below)
+            # doesn't always fall on the second (vert) group — without this
+            # the minority orientation is dropped disproportionately every
+            # epoch. Costs one extra mixed-orientation seam, same as the
+            # horz/vert boundary batch already present.
+            inds = np.roll(inds, int(self._rng.randint(max(n, 1))))
             # Shuffle at (global) batch granularity to keep groups together.
             gb = self.global_batch_size
             nb = n // gb

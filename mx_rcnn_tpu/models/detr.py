@@ -1,0 +1,319 @@
+"""DETR — end-to-end set-prediction detector (stretch config 5, with ViTDet).
+
+Carion et al., "End-to-End Object Detection with Transformers". The
+reference repo predates this family entirely (SURVEY.md §3.2); the TPU
+design choices:
+
+- the Hungarian matcher runs IN-GRAPH via the auction assignment
+  (ops/matching.py) — torch DETRs bounce to scipy on the host every step,
+  the same serialization the reference suffered with its Python CustomOps;
+- everything is static-shape: padded gt sets with validity masks flow
+  straight into the matcher (invalid columns are never assigned);
+- no NMS, no anchors, no proposals — but forward_test emits the SAME
+  (rois, valid, scores, boxes) contract as the other families so
+  Predictor/pred_eval drive it unchanged (the per-class NMS it applies is
+  a near-no-op on DETR's non-overlapping predictions);
+- class index 0 is "no object", matching the framework's background
+  convention (DETR's ∅ class), down-weighted by eos_coef in the CE loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models.backbones import ResNetStages
+from mx_rcnn_tpu.ops.boxes import generalized_iou_xyxy
+from mx_rcnn_tpu.ops.matching import auction_assign
+from mx_rcnn_tpu.ops.ring_attention import dense_attention
+
+Dtype = Any
+
+
+def sine_position_encoding(h: int, w: int, dim: int) -> np.ndarray:
+    """2D sine/cosine positional encoding, (H, W, dim) — DETR's fixed PE."""
+    assert dim % 4 == 0
+    d = dim // 4
+    ys = np.arange(h, dtype=np.float32)[:, None, None] + 0.5
+    xs = np.arange(w, dtype=np.float32)[None, :, None] + 0.5
+    freqs = np.exp(np.arange(d, dtype=np.float32) * -(np.log(10000.0) / d))
+    yf = ys * freqs[None, None, :]
+    xf = xs * freqs[None, None, :]
+    pe = np.concatenate([
+        np.broadcast_to(np.sin(yf), (h, w, d)),
+        np.broadcast_to(np.cos(yf), (h, w, d)),
+        np.broadcast_to(np.sin(xf), (h, w, d)),
+        np.broadcast_to(np.cos(xf), (h, w, d)),
+    ], axis=-1)
+    return pe.astype(np.float32)
+
+
+class MHA(nn.Module):
+    """Multi-head attention with separate q/kv inputs (B, N, C) tokens."""
+
+    dim: int
+    heads: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, q_in, k_in, v_in):
+        b, nq, _ = q_in.shape
+        nk = k_in.shape[1]
+        h = self.heads
+        d = self.dim // h
+        q = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="q")(q_in).reshape(b, nq, h, d)
+        k = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="k")(k_in).reshape(b, nk, h, d)
+        v = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="v")(v_in).reshape(b, nk, h, d)
+        out = dense_attention(q, k, v).reshape(b, nq, self.dim)
+        return nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="proj")(out)
+
+
+class EncoderLayer(nn.Module):
+    dim: int
+    heads: int
+    ffn: int = 2048
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, pos):
+        q = x + pos
+        y = MHA(self.dim, self.heads, dtype=self.dtype, name="self_attn")(
+            q, q, x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="norm1")(x + y)
+        y = nn.Dense(self.ffn, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="ffn1")(x)
+        y = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="ffn2")(nn.relu(y))
+        return nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                            name="norm2")(x + y)
+
+
+class DecoderLayer(nn.Module):
+    dim: int
+    heads: int
+    ffn: int = 2048
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tgt, query_pos, memory, pos):
+        q = tgt + query_pos
+        y = MHA(self.dim, self.heads, dtype=self.dtype, name="self_attn")(
+            q, q, tgt)
+        tgt = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                           name="norm1")(tgt + y)
+        y = MHA(self.dim, self.heads, dtype=self.dtype, name="cross_attn")(
+            tgt + query_pos, memory + pos, memory)
+        tgt = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                           name="norm2")(tgt + y)
+        y = nn.Dense(self.ffn, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="ffn1")(tgt)
+        y = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="ffn2")(nn.relu(y))
+        return nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                            name="norm3")(tgt + y)
+
+
+class DETR(nn.Module):
+    """ResNet backbone (C5, stride 32) + transformer encoder-decoder."""
+
+    depth: int = 50
+    num_classes: int = 81  # index 0 = no-object
+    num_queries: int = 100
+    hidden: int = 256
+    heads: int = 8
+    enc_layers: int = 6
+    dec_layers: int = 6
+    norm: str = "frozen_bn"
+    freeze_at: int = 2
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray):
+        """images (B, H, W, 3) → (logits (B, Q, C), boxes (B, Q, 4)).
+
+        boxes are (cx, cy, w, h) in [0, 1] of the PADDED canvas.
+        """
+        feats = ResNetStages(depth=self.depth, freeze_at=self.freeze_at,
+                             norm=self.norm, dtype=self.dtype,
+                             name="backbone")(images)
+        c5 = feats[3]  # stride 32
+        b, h, w, _ = c5.shape
+        x = nn.Conv(self.hidden, (1, 1), dtype=self.dtype,
+                    param_dtype=jnp.float32, name="input_proj")(c5)
+        pos = jnp.asarray(sine_position_encoding(h, w, self.hidden))
+        pos = jnp.broadcast_to(pos[None], (b, h, w, self.hidden))
+        x = x.reshape(b, h * w, self.hidden)
+        pos = pos.reshape(b, h * w, self.hidden).astype(x.dtype)
+        for i in range(self.enc_layers):
+            x = EncoderLayer(self.hidden, self.heads, dtype=self.dtype,
+                             name=f"enc{i}")(x, pos)
+        query_pos = self.param("query_embed", nn.initializers.normal(1.0),
+                               (self.num_queries, self.hidden), jnp.float32)
+        query_pos = jnp.broadcast_to(
+            query_pos[None], (b, self.num_queries, self.hidden)).astype(
+                x.dtype)
+        tgt = jnp.zeros_like(query_pos)
+        for i in range(self.dec_layers):
+            tgt = DecoderLayer(self.hidden, self.heads, dtype=self.dtype,
+                               name=f"dec{i}")(tgt, query_pos, x, pos)
+        tgt = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                           name="dec_norm")(tgt)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="class_embed")(
+                              tgt.astype(jnp.float32))
+        y = tgt.astype(jnp.float32)
+        for i in range(2):
+            y = nn.relu(nn.Dense(self.hidden, dtype=jnp.float32,
+                                 name=f"bbox_mlp{i}")(y))
+        boxes = jax.nn.sigmoid(
+            nn.Dense(4, dtype=jnp.float32, name="bbox_out")(y))
+        return logits, boxes
+
+
+# ---------------------------------------------------------------------------
+# Set-prediction loss with in-graph matching
+# ---------------------------------------------------------------------------
+
+
+def _cxcywh_to_xyxy(b: jnp.ndarray) -> jnp.ndarray:
+    cx, cy, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def _giou_elementwise(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise gIoU of paired (N, 4) xyxy boxes — the loss only needs the
+    matched pairs, not the (N, M) matrix the matcher's cost uses."""
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, :2], b[:, :2])
+    rb = jnp.minimum(a[:, 2:], b[:, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[:, 0] * wh[:, 1]
+    union = area_a + area_b - inter
+    iou = inter / jnp.maximum(union, 1e-9)
+    hlt = jnp.minimum(a[:, :2], b[:, :2])
+    hrb = jnp.maximum(a[:, 2:], b[:, 2:])
+    hwh = jnp.clip(hrb - hlt, 0)
+    hull = hwh[:, 0] * hwh[:, 1]
+    return iou - (hull - union) / jnp.maximum(hull, 1e-9)
+
+
+def _one_image_loss(logits, boxes, gt_boxes_n, gt_classes, gt_valid, *,
+                    eos_coef, cost_class, cost_l1, cost_giou):
+    """Matched set loss for one image. gt_boxes_n: (G, 4) xyxy NORMALIZED."""
+    q = logits.shape[0]
+    prob = jax.nn.softmax(logits, axis=-1)  # (Q, C)
+    pred_xyxy = _cxcywh_to_xyxy(boxes)
+    gt_cxcywh = jnp.stack([
+        (gt_boxes_n[:, 0] + gt_boxes_n[:, 2]) / 2,
+        (gt_boxes_n[:, 1] + gt_boxes_n[:, 3]) / 2,
+        gt_boxes_n[:, 2] - gt_boxes_n[:, 0],
+        gt_boxes_n[:, 3] - gt_boxes_n[:, 1],
+    ], axis=-1)
+
+    c_class = -prob[:, gt_classes]  # (Q, G)
+    c_l1 = jnp.sum(jnp.abs(boxes[:, None, :] - gt_cxcywh[None, :, :]),
+                   axis=-1)
+    c_giou = -generalized_iou_xyxy(pred_xyxy, gt_boxes_n)
+    cost = cost_class * c_class + cost_l1 * c_l1 + cost_giou * c_giou
+    row_to_col, row_matched = auction_assign(
+        jax.lax.stop_gradient(cost), gt_valid)
+
+    # Classification: matched queries predict their gt class, the rest ∅
+    # (class 0), weighted eos_coef.
+    target = jnp.where(row_matched, gt_classes[row_to_col], 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, target[:, None], axis=-1)[:, 0]
+    wgt = jnp.where(row_matched, 1.0, eos_coef)
+    cls_loss = jnp.sum(ce * wgt) / jnp.maximum(jnp.sum(wgt), 1e-6)
+
+    # Box losses on matched pairs, normalized by gt count.
+    n_gt = jnp.maximum(jnp.sum(gt_valid.astype(jnp.float32)), 1.0)
+    mg = gt_cxcywh[row_to_col]
+    l1 = jnp.sum(jnp.abs(boxes - mg), axis=-1) * row_matched
+    l1_loss = jnp.sum(l1) / n_gt
+    giou_matched = _giou_elementwise(pred_xyxy, gt_boxes_n[row_to_col])
+    giou_loss = jnp.sum((1.0 - giou_matched) * row_matched) / n_gt
+    acc = jnp.sum((jnp.argmax(logits, -1) == target) & row_matched)
+    return cls_loss, l1_loss, giou_loss, acc, jnp.sum(row_matched)
+
+
+def forward_train(model: DETR, params, batch: Dict[str, jnp.ndarray],
+                  rng: jax.Array, cfg: Config
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """DETR train forward — same batch contract as the other families."""
+    images = batch["image"]
+    b, hh, ww, _ = images.shape
+    logits, boxes = model.apply(params, images)
+    scale = jnp.asarray([ww, hh, ww, hh], jnp.float32)
+    gt_n = batch["gt_boxes"] / scale  # normalized xyxy
+
+    cls_l, l1_l, giou_l, acc, nmatch = jax.vmap(
+        lambda lg, bx, g, c, v: _one_image_loss(
+            lg, bx, g, c, v,
+            eos_coef=cfg.train.detr_eos_coef,
+            cost_class=cfg.train.detr_cost_class,
+            cost_l1=cfg.train.detr_cost_l1,
+            cost_giou=cfg.train.detr_cost_giou)
+    )(logits, boxes, gt_n, batch["gt_classes"], batch["gt_valid"])
+
+    cls_loss = jnp.mean(cls_l)
+    l1_loss = jnp.mean(l1_l) * cfg.train.detr_cost_l1
+    giou_loss = jnp.mean(giou_l) * cfg.train.detr_cost_giou
+    total = cls_loss + l1_loss + giou_loss
+    aux = {
+        "rcnn_cls_loss": cls_loss,   # metric-slot reuse (MetricBag names)
+        "rcnn_bbox_loss": l1_loss + giou_loss,
+        "detr_giou_loss": giou_loss,
+        "total_loss": total,
+        "num_fg": jnp.sum(nmatch).astype(jnp.float32),
+    }
+    return total, aux
+
+
+def forward_test(model: DETR, params, images: jnp.ndarray,
+                 im_info: jnp.ndarray, cfg: Config):
+    """DETR inference in the framework's (rois, valid, scores, boxes)
+    contract (see module docstring)."""
+    b, hh, ww, _ = images.shape
+    logits, nboxes = model.apply(params, images)
+    q = nboxes.shape[1]
+    c = logits.shape[-1]
+    scale = jnp.asarray([ww, hh, ww, hh], jnp.float32)
+    xyxy = _cxcywh_to_xyxy(nboxes) * scale  # padded-canvas pixels
+    scores = jax.nn.softmax(logits, axis=-1)  # (B, Q, C); class 0 = ∅
+    boxes_tiled = jnp.tile(xyxy, (1, 1, c))  # (B, Q, 4C)
+    valid = jnp.ones((b, q), bool)
+    return xyxy, valid, scores, boxes_tiled
+
+
+def build_detr_model(cfg: Config) -> DETR:
+    return DETR(
+        depth=cfg.network.depth,
+        num_classes=cfg.dataset.num_classes,
+        num_queries=cfg.network.detr_queries,
+        hidden=cfg.network.detr_hidden,
+        heads=cfg.network.detr_heads,
+        enc_layers=cfg.network.detr_enc_layers,
+        dec_layers=cfg.network.detr_dec_layers,
+        norm=cfg.network.norm,
+        freeze_at=cfg.network.freeze_at,
+        dtype=jnp.dtype(cfg.network.compute_dtype),
+    )
+
+
+def init_detr_params(model: DETR, cfg: Config, rng: jax.Array,
+                     image_shape=None):
+    h, w = image_shape or (64, 64)
+    return model.init(rng, jnp.zeros((1, h, w, 3), jnp.float32))

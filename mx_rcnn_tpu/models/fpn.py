@@ -341,9 +341,9 @@ def pyramid_roi_align(
 
 
 def _pyramid_rpn(model: FPNFasterRCNN, params, images, cfg: Config):
-    pyramid = model.apply(params, images, method=FPNFasterRCNN.extract)
+    pyramid = model.apply(params, images, method="extract")
     rpn_out = model.apply(params, pyramid,
-                          method=FPNFasterRCNN.rpn_forward)
+                          method="rpn_forward")
     shapes = {lv: (pyramid[lv].shape[1], pyramid[lv].shape[2])
               for lv in RPN_LEVELS}
     anchors = pyramid_anchors(shapes, cfg)
@@ -429,7 +429,7 @@ def forward_train(
     pooled = pyramid_roi_align(pyramid, samples.rois, samples.valid,
                                model.roi_pool_size)
     cls_logits, bbox_deltas = model.apply(params, pooled,
-                                          method=FPNFasterRCNN.box_head)
+                                          method="box_head")
 
     labels = jnp.where(samples.valid.reshape(-1),
                        samples.labels.reshape(-1), -1)
@@ -461,7 +461,7 @@ def forward_train(
             pyramid, samples.rois, samples.valid & samples.fg_mask,
             model.mask_pool_size)
         mask_logits = model.apply(params, mask_pooled,
-                                  method=FPNFasterRCNN.mask_forward)
+                                  method="mask_forward")
         m_res = mask_logits.shape[1]
         targets = jax.vmap(
             partial(mask_targets_for_rois, resolution=m_res)
@@ -508,7 +508,7 @@ def forward_test(
     b, r = rois.shape[0], rois.shape[1]
     pooled = pyramid_roi_align(pyramid, rois, roi_valid, model.roi_pool_size)
     cls_logits, bbox_deltas = model.apply(params, pooled,
-                                          method=FPNFasterRCNN.box_head)
+                                          method="box_head")
     scores = jax.nn.softmax(cls_logits, axis=-1).reshape(b, r, -1)
     stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
                     model.num_classes)
@@ -536,11 +536,11 @@ def forward_test_masks(
     Run AFTER detection post-processing (the Mask R-CNN inference recipe:
     masks are predicted on the post-NMS boxes, not the proposals).
     """
-    pyramid = model.apply(params, images, method=FPNFasterRCNN.extract)
+    pyramid = model.apply(params, images, method="extract")
     b, d = det_boxes.shape[0], det_boxes.shape[1]
     pooled = pyramid_roi_align(pyramid, det_boxes, det_valid,
                                model.mask_pool_size)
-    logits = model.apply(params, pooled, method=FPNFasterRCNN.mask_forward)
+    logits = model.apply(params, pooled, method="mask_forward")
     m = logits.shape[1]
     cls_sel = jnp.maximum(det_classes.reshape(-1), 0)
     per_det = jnp.take_along_axis(

@@ -1,0 +1,297 @@
+"""ViTDet — plain ViT backbone + simple feature pyramid detector.
+
+BASELINE.json config 5 (the stretch config; the reference repo predates
+transformers entirely — SURVEY.md §3.2). Follows Li et al., "Exploring
+Plain Vision Transformer Backbones for Object Detection" (ViTDet):
+
+- non-hierarchical ViT encoder at stride 16 (patch 16), windowed attention
+  in most blocks with a few global-attention blocks spread evenly;
+- a Simple Feature Pyramid built from the LAST feature map only (stride-16
+  map → deconv x4 / deconv x2 / identity / maxpool → strides 4/8/16/32),
+  then the SAME multi-level RPN + box/mask heads as models/fpn.py — the
+  class deliberately mirrors FPNFasterRCNN's method surface so
+  fpn.forward_train / forward_test / forward_rpn drive it unchanged
+  (models/zoo.py dispatch).
+
+Long-context: the global-attention blocks can run RING ATTENTION
+(ops/ring_attention.py) with the token sequence sharded over a mesh axis —
+`network.use_ring_attention` + a mesh passed at construction. Window blocks
+are always local (windows never cross device shards; each image row-block
+is self-contained), so only the few global blocks pay ICI traffic, exactly
+the ViTDet compute structure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models.fpn import MaskHead, RPNHead, TwoFCHead
+from mx_rcnn_tpu.ops.ring_attention import dense_attention
+
+Dtype = Any
+
+
+class Attention(nn.Module):
+    """Multi-head self-attention over (B, N, C) tokens."""
+
+    dim: int
+    heads: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, attn_fn=None) -> jnp.ndarray:
+        b, n, c = x.shape
+        h = self.heads
+        d = self.dim // h
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype,
+                       param_dtype=jnp.float32, name="qkv")(x)
+        q, k, v = jnp.split(qkv.reshape(b, n, 3, h, d), 3, axis=2)
+        q, k, v = (t[:, :, 0] for t in (q, k, v))  # (B, N, H, D)
+        attn = attn_fn or dense_attention
+        out = attn(q, k, v)  # (B, N, H, D)
+        out = out.reshape(b, n, self.dim)
+        return nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="proj")(out)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block, windowed or global spatial attention.
+
+    Input/output (B, H, W, C). Window attention partitions the (H, W) grid
+    into window x window tiles (padded if needed) and attends within each —
+    the ViTDet local block. window == 0 → global attention over all H·W
+    tokens (optionally ring attention when attn_fn is given).
+    """
+
+    dim: int
+    heads: int
+    window: int = 0
+    mlp_ratio: float = 4.0
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, attn_fn=None) -> jnp.ndarray:
+        b, h, w, c = x.shape
+        shortcut = x
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="norm1")(x)
+        if self.window > 0:
+            ws = self.window
+            ph = (-h) % ws
+            pw = (-w) % ws
+            y = jnp.pad(y, ((0, 0), (0, ph), (0, pw), (0, 0)))
+            hh, ww = h + ph, w + pw
+            y = y.reshape(b, hh // ws, ws, ww // ws, ws, c)
+            y = y.transpose(0, 1, 3, 2, 4, 5).reshape(-1, ws * ws, c)
+            y = Attention(self.dim, self.heads, dtype=self.dtype,
+                          name="attn")(y)
+            y = y.reshape(b, hh // ws, ww // ws, ws, ws, c)
+            y = y.transpose(0, 1, 3, 2, 4, 5).reshape(b, hh, ww, c)
+            y = y[:, :h, :w]
+        else:
+            y = Attention(self.dim, self.heads, dtype=self.dtype,
+                          name="attn")(y.reshape(b, h * w, c), attn_fn)
+            y = y.reshape(b, h, w, c)
+        x = shortcut + y
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="norm2")(x)
+        y = nn.Dense(int(self.dim * self.mlp_ratio), dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp1")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="mlp2")(y)
+        return x + y
+
+
+class ViTBackbone(nn.Module):
+    """Plain ViT encoder → single stride-16 feature map (B, H/16, W/16, C).
+
+    Global blocks at depth/4 spacing (ViTDet: 4 global blocks for ViT-B);
+    the rest use `window`-sized local attention. Absolute position
+    embeddings are bilinearly resized to the runtime grid (static under
+    jit — shapes are compile-time).
+    """
+
+    patch: int = 16
+    dim: int = 768
+    depth: int = 12
+    heads: int = 12
+    window: int = 8
+    dtype: Dtype = jnp.bfloat16
+    # Pretraining grid for pos-embed params; resized to runtime grid.
+    pos_grid: int = 32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, attn_fn=None) -> jnp.ndarray:
+        b = x.shape[0]
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), dtype=self.dtype,
+                    param_dtype=jnp.float32, name="patch_embed")(
+                        x.astype(self.dtype))
+        h, w = x.shape[1], x.shape[2]
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.pos_grid, self.pos_grid, self.dim),
+                         jnp.float32)
+        pos = jax.image.resize(pos, (1, h, w, self.dim), "bilinear")
+        x = x + pos.astype(self.dtype)
+        # ViTDet: split the depth into 4 subsets, each ENDING with a global
+        # block (ViT-B depth 12 → globals at 2, 5, 8, 11); degenerate small
+        # depths (< 4) make every block global.
+        global_blocks = {self.depth * k // 4 - 1 for k in range(1, 5)}
+        global_blocks = {i for i in global_blocks if i >= 0} or {self.depth - 1}
+        for i in range(self.depth):
+            is_global = i in global_blocks
+            x = Block(self.dim, self.heads,
+                      window=0 if is_global else self.window,
+                      dtype=self.dtype, name=f"block{i}")(
+                          x, attn_fn if is_global else None)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="norm")(x)
+        return x
+
+
+class SimpleFeaturePyramid(nn.Module):
+    """ViTDet SFP: stride-16 map → {P2..P6} 256-channel pyramid."""
+
+    channels: int = 256
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, feat: jnp.ndarray) -> Dict[int, jnp.ndarray]:
+        def out_convs(y, lv):
+            y = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
+                        param_dtype=jnp.float32, name=f"out{lv}_1")(y)
+            y = nn.Conv(self.channels, (3, 3), padding=[(1, 1), (1, 1)],
+                        dtype=self.dtype, param_dtype=jnp.float32,
+                        name=f"out{lv}_3")(y)
+            return y
+
+        c = feat.shape[-1]
+        # stride 4: two stride-2 deconvs (with an intermediate norm+gelu).
+        y4 = nn.ConvTranspose(c // 2, (2, 2), strides=(2, 2),
+                              dtype=self.dtype, param_dtype=jnp.float32,
+                              name="up4_1")(feat)
+        y4 = nn.gelu(nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                                  name="up4_ln")(y4))
+        y4 = nn.ConvTranspose(c // 4, (2, 2), strides=(2, 2),
+                              dtype=self.dtype, param_dtype=jnp.float32,
+                              name="up4_2")(y4)
+        y8 = nn.ConvTranspose(c // 2, (2, 2), strides=(2, 2),
+                              dtype=self.dtype, param_dtype=jnp.float32,
+                              name="up8")(feat)
+        out = {
+            2: out_convs(y4, 2),
+            3: out_convs(y8, 3),
+            4: out_convs(feat, 4),
+            5: out_convs(nn.max_pool(feat, (2, 2), strides=(2, 2)), 5),
+        }
+        out[6] = nn.max_pool(out[5], (1, 1), strides=(2, 2))
+        return out
+
+
+class ViTDet(nn.Module):
+    """ViT backbone + SFP + the FPN detection heads.
+
+    Mirrors models/fpn.py::FPNFasterRCNN's method surface (extract /
+    rpn_forward / box_head / mask_forward and the attrs the functional
+    forwards read), so fpn.forward_train/forward_test/forward_rpn drive it
+    via models/zoo.py without modification.
+    """
+
+    num_classes: int = 81
+    num_anchors: int = 3
+    fpn_channels: int = 256
+    roi_pool_size: int = 7
+    use_mask: bool = False
+    mask_pool_size: int = 14
+    patch: int = 16
+    dim: int = 768
+    depth: int = 12
+    heads: int = 12
+    window: int = 8
+    dtype: Dtype = jnp.bfloat16
+    # Optional ring-attention backend for the global blocks: a callable
+    # (q, k, v) -> out, typically partial(ring_attention, mesh=mesh).
+    # Static (non-pytree) module field.
+    global_attn_fn: Optional[Any] = None
+
+    def setup(self):
+        self.features = ViTBackbone(patch=self.patch, dim=self.dim,
+                                    depth=self.depth, heads=self.heads,
+                                    window=self.window, dtype=self.dtype)
+        self.neck = SimpleFeaturePyramid(channels=self.fpn_channels,
+                                         dtype=self.dtype)
+        self.rpn = RPNHead(num_anchors=self.num_anchors,
+                           channels=self.fpn_channels, dtype=self.dtype)
+        self.head = TwoFCHead(dtype=self.dtype)
+        self.cls_score = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.01), name="cls_score")
+        self.bbox_pred = nn.Dense(
+            self.num_classes * 4, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.001), name="bbox_pred")
+        if self.use_mask:
+            self.mask_head = MaskHead(num_classes=self.num_classes,
+                                      dtype=self.dtype)
+
+    def extract(self, images: jnp.ndarray) -> Dict[int, jnp.ndarray]:
+        feat = self.features(images, self.global_attn_fn)
+        return self.neck(feat)
+
+    def rpn_forward(self, pyramid: Dict[int, jnp.ndarray]):
+        from mx_rcnn_tpu.models.fpn import RPN_LEVELS
+
+        return {lv: self.rpn(pyramid[lv]) for lv in RPN_LEVELS}
+
+    def box_head(self, pooled: jnp.ndarray):
+        x = self.head(pooled)
+        return (self.cls_score(x).astype(jnp.float32),
+                self.bbox_pred(x).astype(jnp.float32))
+
+    def mask_forward(self, pooled: jnp.ndarray):
+        return self.mask_head(pooled)
+
+    def __call__(self, images: jnp.ndarray, rois: jnp.ndarray):
+        from mx_rcnn_tpu.ops.roi_align import roi_align
+
+        pyramid = self.extract(images)
+        rpn_out = self.rpn_forward(pyramid)
+        pooled = roi_align(pyramid[2], rois, self.roi_pool_size, 1.0 / 4.0)
+        cls, box = self.box_head(pooled)
+        outs = (pyramid, rpn_out, cls, box)
+        if self.use_mask:
+            mp = roi_align(pyramid[2], rois, self.mask_pool_size, 1.0 / 4.0)
+            outs = outs + (self.mask_forward(mp),)
+        return outs
+
+
+def build_vitdet_model(cfg: Config, global_attn_fn=None) -> ViTDet:
+    return ViTDet(
+        num_classes=cfg.dataset.num_classes,
+        num_anchors=cfg.network.num_anchors,
+        fpn_channels=cfg.network.fpn_channels,
+        roi_pool_size=cfg.network.roi_pool_size,
+        use_mask=cfg.network.use_mask,
+        mask_pool_size=cfg.network.mask_pool_size,
+        patch=cfg.network.vit_patch,
+        dim=cfg.network.vit_dim,
+        depth=cfg.network.vit_depth,
+        heads=cfg.network.vit_heads,
+        window=cfg.network.vit_window,
+        dtype=jnp.dtype(cfg.network.compute_dtype),
+        global_attn_fn=global_attn_fn,
+    )
+
+
+def init_vitdet_params(model: ViTDet, cfg: Config, rng: jax.Array,
+                       image_shape=None):
+    h, w = image_shape or (64, 64)
+    images = jnp.zeros((1, h, w, 3), jnp.float32)
+    rois = jnp.asarray([[0.0, 0.0, 0.0, 31.0, 31.0]], jnp.float32)
+    return model.init(rng, images, rois)

@@ -21,31 +21,79 @@ from mx_rcnn_tpu.models import faster_rcnn as _c4
 from mx_rcnn_tpu.models import fpn as _fpn
 
 
-def build_model(cfg: Config):
+def _is_pyramid_model(model) -> bool:
+    """FPN and ViTDet share the pyramid method surface and the fpn.py
+    functional forwards (duck-typed via string method names)."""
+    from mx_rcnn_tpu.models import vit as _vit
+
+    return isinstance(model, (_fpn.FPNFasterRCNN, _vit.ViTDet))
+
+
+def build_model(cfg: Config, mesh=None):
+    """Config → model. `mesh` enables ring attention for ViTDet configs
+    with network.use_ring_attention (the global blocks shard the token
+    sequence over the mesh's model axis)."""
+    if cfg.network.use_detr:
+        from mx_rcnn_tpu.models import detr as _detr
+
+        return _detr.build_detr_model(cfg)
+    if cfg.network.use_vit:
+        from functools import partial
+
+        from mx_rcnn_tpu.models import vit as _vit
+        from mx_rcnn_tpu.ops.ring_attention import ring_attention
+
+        attn_fn = None
+        if cfg.network.use_ring_attention and mesh is not None:
+            attn_fn = partial(ring_attention, mesh=mesh, axis="model")
+        return _vit.build_vitdet_model(cfg, global_attn_fn=attn_fn)
     if cfg.network.use_fpn:
         return _fpn.build_fpn_model(cfg)
     return _c4.build_model(cfg)
 
 
 def init_params(model, cfg: Config, rng, image_shape=None):
+    from mx_rcnn_tpu.models import detr as _detr
+    from mx_rcnn_tpu.models import vit as _vit
+
+    if isinstance(model, _detr.DETR):
+        return _detr.init_detr_params(model, cfg, rng, image_shape)
+    if isinstance(model, _vit.ViTDet):
+        return _vit.init_vitdet_params(model, cfg, rng, image_shape)
     if isinstance(model, _fpn.FPNFasterRCNN):
         return _fpn.init_fpn_params(model, cfg, rng, image_shape)
     return _c4.init_params(model, cfg, rng, image_shape)
 
 
+def _is_detr(model) -> bool:
+    from mx_rcnn_tpu.models import detr as _detr
+
+    return isinstance(model, _detr.DETR)
+
+
 def forward_train(model, params, batch, rng, cfg: Config):
-    if isinstance(model, _fpn.FPNFasterRCNN):
+    if _is_detr(model):
+        from mx_rcnn_tpu.models import detr as _detr
+
+        return _detr.forward_train(model, params, batch, rng, cfg)
+    if _is_pyramid_model(model):
         return _fpn.forward_train(model, params, batch, rng, cfg)
     return _c4.forward_train(model, params, batch, rng, cfg)
 
 
 def forward_test(model, params, images, im_info, cfg: Config):
-    if isinstance(model, _fpn.FPNFasterRCNN):
+    if _is_detr(model):
+        from mx_rcnn_tpu.models import detr as _detr
+
+        return _detr.forward_test(model, params, images, im_info, cfg)
+    if _is_pyramid_model(model):
         return _fpn.forward_test(model, params, images, im_info, cfg)
     return _c4.forward_test(model, params, images, im_info, cfg)
 
 
 def forward_rpn(model, params, images, im_info, cfg: Config):
-    if isinstance(model, _fpn.FPNFasterRCNN):
+    if _is_detr(model):
+        raise NotImplementedError("DETR has no RPN / proposal path")
+    if _is_pyramid_model(model):
         return _fpn.forward_rpn(model, params, images, im_info, cfg)
     return _c4.forward_rpn(model, params, images, im_info, cfg)

@@ -116,3 +116,26 @@ def bbox_overlaps(boxes: jnp.ndarray, query_boxes: jnp.ndarray) -> jnp.ndarray:
     area_q = (q[..., 2] - q[..., 0] + 1.0) * (q[..., 3] - q[..., 1] + 1.0)
     union = area_b + area_q - inter
     return inter / jnp.maximum(union, 1e-14)
+
+
+def generalized_iou_xyxy(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise generalized IoU, EXCLUSIVE (x1, y1, x2, y2) convention.
+
+    (N, 4) x (M, 4) -> (N, M). Used by the DETR matcher/loss
+    (models/detr.py) — gIoU = IoU − |hull − union| / |hull| (Rezatofighi
+    et al.). Exclusive widths (x2 − x1), unlike the classic +1-inclusive
+    ops above, because DETR boxes are continuous normalized coordinates.
+    """
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    iou = inter / jnp.maximum(union, 1e-9)
+    hlt = jnp.minimum(a[:, None, :2], b[None, :, :2])
+    hrb = jnp.maximum(a[:, None, 2:], b[None, :, 2:])
+    hwh = jnp.clip(hrb - hlt, 0)
+    hull = hwh[..., 0] * hwh[..., 1]
+    return iou - (hull - union) / jnp.maximum(hull, 1e-9)

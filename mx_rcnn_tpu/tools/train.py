@@ -90,7 +90,7 @@ def fit_detector(
             fixed_param_patterns=tuple(cfg.network.fixed_param_patterns)
             + tuple(fixed_param_patterns)))
 
-    model = build_model(cfg)
+    model = build_model(cfg, mesh=mesh)  # mesh: ring attention for ViTDet
     params = pretrained_params or init_params(
         model, cfg, jax.random.PRNGKey(seed))
     if loader_factory is None:

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# DETR-R50 on COCO (stretch config 5): set prediction with in-graph auction
+# matching, no NMS / anchors / proposals. DETR needs long schedules
+# (~300+ epochs on real COCO); this recipe pins the flags, not the wall time.
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+python train_end2end.py \
+  --network detr_r50 --dataset coco --image_set train2017 \
+  --prefix model/detr_r50_coco --end_epoch 300 --lr 0.0001 --lr_step 200 \
+  --tpu-mesh "${TPU_MESH:-8}" "$@"
+
+python test.py \
+  --network detr_r50 --dataset coco --image_set val2017 \
+  --prefix model/detr_r50_coco --epoch 300 \
+  --out_json results/detr_r50_coco_dets.json

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# ViTDet-B on COCO (BASELINE.json config 5, stretch): plain ViT backbone +
+# simple feature pyramid + the FPN detection heads. Ring attention for the
+# global blocks activates with a model axis: TPU_MESH=4x2 shards the token
+# sequence of global-attention blocks over the 2-wide model axis.
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+python train_end2end.py \
+  --network vitdet_b --dataset coco --image_set train2017 \
+  --prefix model/vitdet_b_coco --end_epoch 8 --lr 0.0001 --lr_step 6 \
+  --tpu-mesh "${TPU_MESH:-8}" "$@"
+
+python test.py \
+  --network vitdet_b --dataset coco --image_set val2017 \
+  --prefix model/vitdet_b_coco --epoch 8 \
+  --out_json results/vitdet_b_coco_dets.json

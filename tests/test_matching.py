@@ -1,0 +1,65 @@
+"""Auction assignment (ops/matching.py) vs scipy's Hungarian oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+from mx_rcnn_tpu.ops.matching import auction_assign
+
+
+def _total_cost(cost, row_to_col, matched):
+    return float(sum(cost[i, c] for i, (c, m) in
+                     enumerate(zip(row_to_col, matched)) if m))
+
+
+@pytest.mark.parametrize("n,m", [(5, 5), (10, 4), (20, 8), (100, 30)])
+def test_matches_scipy_total_cost(rng, n, m):
+    for trial in range(3):
+        cost = rng.rand(n, m).astype(np.float32)
+        valid = np.ones(m, bool)
+        r2c, matched = auction_assign(jnp.asarray(cost), jnp.asarray(valid))
+        r2c, matched = np.asarray(r2c), np.asarray(matched)
+        # Every valid column assigned exactly once.
+        assigned_cols = r2c[matched]
+        assert len(assigned_cols) == m
+        assert len(set(assigned_cols.tolist())) == m
+        got = _total_cost(cost, r2c, matched)
+        ri, ci = linear_sum_assignment(cost)
+        want = float(cost[ri, ci].sum())
+        assert got == pytest.approx(want, abs=1e-2), (trial, got, want)
+
+
+def test_invalid_columns_ignored(rng):
+    cost = rng.rand(8, 6).astype(np.float32)
+    valid = np.array([True, True, False, True, False, False])
+    r2c, matched = auction_assign(jnp.asarray(cost), jnp.asarray(valid))
+    r2c, matched = np.asarray(r2c), np.asarray(matched)
+    assert matched.sum() == 3
+    assert set(r2c[matched].tolist()) == {0, 1, 3}
+    got = _total_cost(cost, r2c, matched)
+    ri, ci = linear_sum_assignment(cost[:, [0, 1, 3]])
+    want = float(cost[:, [0, 1, 3]][ri, ci].sum())
+    assert got == pytest.approx(want, abs=1e-2)
+
+
+def test_all_invalid(rng):
+    cost = rng.rand(4, 3).astype(np.float32)
+    r2c, matched = auction_assign(jnp.asarray(cost),
+                                  jnp.zeros(3, bool))
+    assert not np.asarray(matched).any()
+
+
+def test_under_jit_and_adversarial(rng):
+    # Near-tied costs — the eps bound must still find the optimum at the
+    # test tolerance.
+    cost = np.zeros((6, 6), np.float32)
+    cost += rng.rand(6, 6) * 1e-2
+    cost[np.arange(6), np.arange(6)] -= 1.0  # strong diagonal optimum
+    r2c, matched = jax.jit(auction_assign)(jnp.asarray(cost),
+                                           jnp.ones(6, bool))
+    assert np.asarray(matched).all()
+    got = _total_cost(cost, np.asarray(r2c), np.asarray(matched))
+    ri, ci = linear_sum_assignment(cost)
+    assert got == pytest.approx(float(cost[ri, ci].sum()), abs=1e-2)
