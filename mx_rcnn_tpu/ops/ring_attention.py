@@ -48,14 +48,29 @@ def _block_attn_update(carry, kv, q, scale):
     return acc, m_new, l
 
 
-def ring_attention_sharded(q, k, v, axis_name: str, scale=None):
+def _mark_varying(x, axes):
+    """Mark x as varying over the given mesh axes (shard_map manual-axes
+    type tracking). pvary is deprecated in favor of pcast in jax >= 0.9."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    return lax.pvary(x, axes)
+
+
+def ring_attention_sharded(q, k, v, axis_name: str, scale=None,
+                           vary_axes=None):
     """Attention with the SEQUENCE axis sharded over `axis_name`.
 
     To be called inside shard_map (or pmapped code): q/k/v are the LOCAL
     shards, shape (..., s_local, h, d). Returns the local output shard,
     (..., s_local, h, d), float32 accumulation cast back to q.dtype.
+
+    vary_axes: all mesh axes the q/k/v shards vary over (defaults to just
+    the ring axis). When the caller also shards the batch dim over another
+    axis (DP×SP), that axis must be included so the fori_loop carry's
+    varying-axes type matches the loop body's output.
     """
     p_size = lax.psum(1, axis_name)
+    vary = tuple(vary_axes) if vary_axes is not None else (axis_name,)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     h, d = q.shape[-2], q.shape[-1]
     q_len = q.shape[-3]
@@ -64,10 +79,10 @@ def ring_attention_sharded(q, k, v, axis_name: str, scale=None):
     acc = jnp.zeros(batch_shape + (h, q_len, d), jnp.float32)
     m = jnp.full(batch_shape + (h, q_len), -jnp.inf, jnp.float32)
     l = jnp.zeros(batch_shape + (h, q_len), jnp.float32)
-    # Mark the carry as varying over the ring axis (the body mixes it with
-    # sharded operands; shard_map's manual-axes tracking requires the
-    # fori_loop carry types to agree).
-    acc, m, l = (lax.pvary(x, axis_name) for x in (acc, m, l))
+    # Mark the carry as varying over every sharded operand axis (the body
+    # mixes it with sharded operands; shard_map's manual-axes tracking
+    # requires the fori_loop carry types to agree).
+    acc, m, l = (_mark_varying(x, vary) for x in (acc, m, l))
 
     def body(i, carry):
         acc, m, l, k_cur, v_cur = carry
@@ -102,11 +117,17 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "data", scale=None):
     """
     batch_axis = None
     if "data" in mesh.axis_names and axis != "data" \
-            and mesh.shape["data"] > 1:
+            and mesh.shape["data"] > 1 \
+            and q.shape[0] % mesh.shape["data"] == 0:
+        # Skip batch sharding when the batch doesn't tile the data axis —
+        # notably the batch-1 dummy of init_vitdet_params; the real train
+        # step always passes a data-divisible global batch.
         batch_axis = "data"
     spec = P(batch_axis, axis, None, None)
+    vary = (axis,) if batch_axis is None else (axis, batch_axis)
     fn = jax.shard_map(
-        partial(ring_attention_sharded, axis_name=axis, scale=scale),
+        partial(ring_attention_sharded, axis_name=axis, scale=scale,
+                vary_axes=vary),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

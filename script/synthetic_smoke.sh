@@ -13,5 +13,5 @@ python test.py \
   --network resnet50 --dataset synthetic --from-scratch \
   --prefix model/synthetic_smoke --epoch 2
 
-python demo.py --network resnet50 --from-scratch --prefix model/synthetic_smoke --epoch 2 \
-  || true  # demo draws boxes; tolerate headless failures
+python demo.py --network resnet50 --dataset synthetic --from-scratch \
+  --prefix model/synthetic_smoke --epoch 2

@@ -78,3 +78,25 @@ def test_bf16_io(rng):
     want = dense_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(want), rtol=0.05, atol=0.05)
+
+
+def test_ring_dp_sp_batch_sharded(rng):
+    """DP x SP layout: ring over 'model' with the batch sharded over
+    'data' (the ViTDet use_ring_attention layout). Regression: the
+    fori_loop carry must be marked varying over BOTH axes, and a batch
+    not divisible by the data axis (init_vitdet_params' batch-1 dummy)
+    must fall back to a replicated batch instead of failing device_put."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = create_mesh("4x2")
+    q, k, v = _qkv(rng, b=4, s=16)
+    out = ring_attention(q, k, v, mesh, axis="model")
+    want = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # batch 1 (not divisible by data=4): replicated-batch fallback.
+    q1, k1, v1 = _qkv(rng, b=1, s=16)
+    out1 = ring_attention(q1, k1, v1, mesh, axis="model")
+    np.testing.assert_allclose(np.asarray(out1),
+                               np.asarray(dense_attention(q1, k1, v1)),
+                               rtol=2e-5, atol=2e-5)
