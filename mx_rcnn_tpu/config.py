@@ -62,9 +62,13 @@ class NetworkConfig:
     vit_depth: int = 12
     vit_heads: int = 12
     vit_window: int = 8  # local-attention window (tokens per side)
-    # Ring attention for the global blocks (sequence-parallel long context,
+    # Sequence-parallel attention for the global blocks (long context,
     # ops/ring_attention.py); needs a mesh at model build time.
+    # use_ring_attention selects the ppermute ring; sp_mode overrides the
+    # formulation: "ring" | "ulysses" (all-to-all; heads must divide by
+    # the mesh model-axis size).
     use_ring_attention: bool = False
+    sp_mode: str = "ring"
     # DETR (stretch config; models/detr.py).
     use_detr: bool = False
     detr_queries: int = 100

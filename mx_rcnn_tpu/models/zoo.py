@@ -30,9 +30,28 @@ def _is_pyramid_model(model) -> bool:
 
 
 def build_model(cfg: Config, mesh=None):
-    """Config → model. `mesh` enables ring attention for ViTDet configs
-    with network.use_ring_attention (the global blocks shard the token
-    sequence over the mesh's model axis)."""
+    """Config → model. For ViTDet configs, `mesh` + an SP request turn on
+    sequence-parallel global attention over the mesh's model axis: either
+    network.use_ring_attention=True (ring by default) or
+    network.sp_mode="ulysses" (all-to-all) alone enables it."""
+    if cfg.network.sp_mode not in ("ring", "ulysses"):
+        raise ValueError(
+            f"network.sp_mode must be 'ring' or 'ulysses', got "
+            f"{cfg.network.sp_mode!r}")
+    # SP is requested by use_ring_attention=True (legacy knob, ring by
+    # default) or by naming a non-default sp_mode outright; only the ViT
+    # global-attention blocks have a sequence to shard.
+    wants_sp = (cfg.network.use_ring_attention
+                or cfg.network.sp_mode != "ring")
+    if wants_sp and not cfg.network.use_vit:
+        from mx_rcnn_tpu.logger import logger
+
+        logger.warning(
+            "sequence parallelism (use_ring_attention=%s, sp_mode=%r) has "
+            "no effect on %s: only the ViTDet global-attention blocks "
+            "have a token sequence to shard",
+            cfg.network.use_ring_attention, cfg.network.sp_mode,
+            cfg.network.name)
     if cfg.network.use_detr:
         from mx_rcnn_tpu.models import detr as _detr
 
@@ -41,11 +60,34 @@ def build_model(cfg: Config, mesh=None):
         from functools import partial
 
         from mx_rcnn_tpu.models import vit as _vit
-        from mx_rcnn_tpu.ops.ring_attention import ring_attention
+        from mx_rcnn_tpu.ops.ring_attention import (
+            ring_attention, ulysses_attention)
 
         attn_fn = None
-        if cfg.network.use_ring_attention and mesh is not None:
-            attn_fn = partial(ring_attention, mesh=mesh, axis="model")
+        if wants_sp and mesh is not None:
+            if (cfg.network.sp_mode == "ulysses"
+                    and "model" in mesh.axis_names
+                    and cfg.network.vit_heads % mesh.shape["model"] != 0):
+                # Fail at build time, not at first trace.
+                raise ValueError(
+                    f"sp_mode='ulysses' needs vit_heads "
+                    f"({cfg.network.vit_heads}) divisible by the mesh "
+                    f"model axis ({mesh.shape['model']}); use the ring "
+                    "formulation for head-indivisible layouts")
+            sp = (ulysses_attention if cfg.network.sp_mode == "ulysses"
+                  else ring_attention)
+            attn_fn = partial(sp, mesh=mesh, axis="model")
+        elif wants_sp:
+            # Not an error: SP modes are exact, so a dense build (inference
+            # on one chip — no mesh passed) is mathematically identical —
+            # but flag it, since the config asked for a parallel layout.
+            from mx_rcnn_tpu.logger import logger
+
+            logger.warning(
+                "sequence parallelism (use_ring_attention=%s, sp_mode=%r) "
+                "ignored: build_model() was called without a mesh; using "
+                "dense attention (same numerics, no SP)",
+                cfg.network.use_ring_attention, cfg.network.sp_mode)
         return _vit.build_vitdet_model(cfg, global_attn_fn=attn_fn)
     if cfg.network.use_fpn:
         return _fpn.build_fpn_model(cfg)

@@ -195,3 +195,27 @@ def test_global_block_pattern_vitb():
     depth = 12
     global_blocks = {depth * k // 4 - 1 for k in range(1, 5)}
     assert global_blocks == {2, 5, 8, 11}
+
+
+def test_ulysses_attention_matches_dense(rng):
+    """ViTDet with all-to-all (Ulysses) SP over a 2-way model axis ==
+    dense (network.sp_mode='ulysses'; tiny_cfg has 2 heads, so the axis
+    size must divide 2)."""
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    cfg = tiny_cfg(**{"network.use_ring_attention": True,
+                      "network.sp_mode": "ulysses"})
+    mesh = create_mesh("1x2")
+    model_sp = zoo.build_model(cfg, mesh=mesh)
+    cfg_dense = cfg.with_updates(
+        network=replace(cfg.network, use_ring_attention=False,
+                        sp_mode="ring"))
+    model_dense = zoo.build_model(cfg_dense)
+    params = zoo.init_params(model_dense, cfg_dense, jax.random.PRNGKey(0))
+    batch = tiny_batch(rng)
+    key = jax.random.PRNGKey(1)
+    l_sp, _ = jax.jit(lambda p, b, r: zoo.forward_train(
+        model_sp, p, b, r, cfg))(params, batch, key)
+    l_dense, _ = jax.jit(lambda p, b, r: zoo.forward_train(
+        model_dense, p, b, r, cfg_dense))(params, batch, key)
+    assert np.isclose(float(l_sp), float(l_dense), rtol=1e-4)
