@@ -6,8 +6,8 @@ per-class threshold + NMS + max_per_image, then imdb.evaluate_detections),
 im_proposal/generate_proposals (RPN proposal dump for alternate training).
 
 TPU deltas: decode + per-class NMS run INSIDE the jitted forward
-(ops/detection.py::multiclass_nms); only the final (max_per_image, 6) tensor
-reaches the host. Batch > 1 inference is supported (the reference's
+(ops/detection.py::multiclass_nms); only ONE final packed
+(B, max_per_image, 7) tensor reaches the host per batch. Batch > 1 inference is supported (the reference's
 TestLoader is batch-1 only).
 """
 
@@ -49,7 +49,15 @@ class Predictor:
                 nms_thresh=cfg.test.nms_thresh,
                 max_per_image=cfg.test.max_per_image,
             )
-            return dets
+            # Pack into ONE (B, M, 7) tensor [cls, score, x1, y1, x2, y2,
+            # valid] so a single device→host read returns everything —
+            # through a remote-relay device each separate read pays a full
+            # round trip (measured ~95 ms/array on axon; see PERF.md).
+            return jnp.concatenate(
+                [dets.classes[..., None].astype(jnp.float32),
+                 dets.scores[..., None],
+                 dets.boxes,
+                 dets.valid[..., None].astype(jnp.float32)], axis=-1)
 
         def _propose(params, image, im_info):
             # RPN-only path: backbone + RPN + proposal op, no box head
@@ -67,7 +75,10 @@ class Predictor:
         self._masks = jax.jit(_masks) if self.use_mask else None
 
     def detect(self, image: np.ndarray, im_info: np.ndarray):
-        return self._detect(self.params, jnp.asarray(image), jnp.asarray(im_info))
+        """Packed (B, M, 7) detections [cls, score, x1, y1, x2, y2, valid],
+        network-input coordinates, still on device. Host numpy args go
+        straight to the jitted call (one dispatch does both transfers)."""
+        return self._detect(self.params, image, im_info)
 
     def propose(self, image: np.ndarray, im_info: np.ndarray):
         return self._propose(self.params, jnp.asarray(image), jnp.asarray(im_info))
@@ -87,20 +98,17 @@ def im_detect(predictor: Predictor, image: np.ndarray, im_info: np.ndarray,
 
     Returns per-image arrays (n, 6): [cls, score, x1, y1, x2, y2].
     """
-    dets = predictor.detect(image, im_info)
-    boxes = np.asarray(dets.boxes)
-    scores = np.asarray(dets.scores)
-    classes = np.asarray(dets.classes)
-    valid = np.asarray(dets.valid)
+    return _split_packed(
+        np.asarray(predictor.detect(image, im_info)), scale)
+
+
+def _split_packed(packed: np.ndarray, scale: float) -> List[np.ndarray]:
+    """(B, M, 7) packed detections → per-image (n, 6) arrays at 1/scale."""
     out = []
-    for b in range(boxes.shape[0]):
-        v = valid[b]
-        arr = np.concatenate(
-            [classes[b, v, None].astype(np.float32),
-             scores[b, v, None],
-             boxes[b, v] / scale],
-            axis=1,
-        )
+    for b in range(packed.shape[0]):
+        v = packed[b, :, 6] > 0.5
+        arr = packed[b, v, :6]  # advanced indexing -> fresh array
+        arr[:, 2:6] /= scale
         out.append(arr)
     return out
 
@@ -127,16 +135,21 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
         [[] for _ in range(num_images)] for _ in range(num_classes)
     ] if want_masks else None
     done = 0
-    for batch, metas in test_loader:
-        per_image = im_detect(
-            predictor, batch["image"], batch["im_info"], metas[0]["scale"])
+
+    def _process(dev_packed, batch, metas):
+        nonlocal done
+        # The host read happens HERE — one batch after the detect was
+        # enqueued, so it overlaps the next batch's device work (through a
+        # remote-relay device the synchronous read-per-batch pattern is
+        # round-trip-latency-bound; see PERF.md).
+        per_image = _split_packed(np.asarray(dev_packed), metas[0]["scale"])
         if vis:
             _vis_batch(batch, metas, per_image, imdb, test_loader, vis_dir)
         if want_masks:
             per_image_rles = _batch_mask_rles(
                 predictor, batch, metas, per_image, test_loader)
-        # per-image scales differ; recompute per image (im_detect used the
-        # first scale — fix up here for the general batch case).
+        # per-image scales differ; recompute per image (the packed split
+        # used the first scale — fix up here for the general batch case).
         for i, meta in enumerate(metas):
             if not meta["real"]:
                 continue
@@ -157,6 +170,17 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
             done += 1
         if done % 100 < len(metas):
             logger.info("im_detect: %d/%d", done, num_images)
+
+    # 1-deep pipeline: enqueue batch i+1's detect before reading batch i's
+    # results, so host post-processing and device compute overlap.
+    pending = None
+    for batch, metas in test_loader:
+        dev_packed = predictor.detect(batch["image"], batch["im_info"])
+        if pending is not None:
+            _process(*pending)
+        pending = (dev_packed, batch, metas)
+    if pending is not None:
+        _process(*pending)
     kwargs = {}
     if out_json:
         kwargs["out_json"] = out_json
