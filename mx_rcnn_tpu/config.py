@@ -47,6 +47,10 @@ class NetworkConfig:
     freeze_at: int = 2
     # bfloat16 compute for conv/matmul path.
     compute_dtype: str = "bfloat16"
+    # Rematerialize ResNet stage activations in the backward (jax.checkpoint
+    # via nn.remat) — trades ~1/3 extra FLOPs for HBM, enabling bigger
+    # images / per-chip batches (models/backbones.py).
+    remat: bool = False
     # FPN (off for the classic C4 configs).
     use_fpn: bool = False
     fpn_strides: tuple = (4, 8, 16, 32, 64)

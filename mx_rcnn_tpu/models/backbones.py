@@ -143,10 +143,15 @@ class ResNetC4(nn.Module):
     freeze_at: int = 2  # 0=no freeze, 1=stem, 2=stem+stage1 (reference default)
     norm: str = "frozen_bn"
     dtype: Dtype = jnp.bfloat16
+    remat: bool = False  # rematerialize stage activations in the backward
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         blocks = STAGE_BLOCKS[self.depth]
+        # jax.checkpoint per stage: trades ~1/3 extra FLOPs for not keeping
+        # every block's activations live through the backward — the HBM
+        # lever for big images / batch > 1 (network.remat).
+        Stage = nn.remat(ResNetStage) if self.remat else ResNetStage
         x = x.astype(self.dtype)
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
@@ -156,14 +161,14 @@ class ResNetC4(nn.Module):
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         if self.freeze_at >= 1:
             x = jax.lax.stop_gradient(x)
-        x = ResNetStage(blocks[0], 64, stride=1, norm=self.norm,
-                        dtype=self.dtype, name="stage1")(x)
+        x = Stage(blocks[0], 64, stride=1, norm=self.norm,
+                  dtype=self.dtype, name="stage1")(x)
         if self.freeze_at >= 2:
             x = jax.lax.stop_gradient(x)
-        x = ResNetStage(blocks[1], 128, stride=2, norm=self.norm,
-                        dtype=self.dtype, name="stage2")(x)
-        x = ResNetStage(blocks[2], 256, stride=2, norm=self.norm,
-                        dtype=self.dtype, name="stage3")(x)
+        x = Stage(blocks[1], 128, stride=2, norm=self.norm,
+                  dtype=self.dtype, name="stage2")(x)
+        x = Stage(blocks[2], 256, stride=2, norm=self.norm,
+                  dtype=self.dtype, name="stage3")(x)
         return x  # (B, H/16, W/16, 1024)
 
 
@@ -177,10 +182,12 @@ class ResNetStages(nn.Module):
     freeze_at: int = 2
     norm: str = "frozen_bn"
     dtype: Dtype = jnp.bfloat16
+    remat: bool = False  # see ResNetC4.remat
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Sequence[jnp.ndarray]:
         blocks = STAGE_BLOCKS[self.depth]
+        Stage = nn.remat(ResNetStage) if self.remat else ResNetStage
         x = x.astype(self.dtype)
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
@@ -190,16 +197,16 @@ class ResNetStages(nn.Module):
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         if self.freeze_at >= 1:
             x = jax.lax.stop_gradient(x)
-        c2 = ResNetStage(blocks[0], 64, stride=1, norm=self.norm,
-                        dtype=self.dtype, name="stage1")(x)
+        c2 = Stage(blocks[0], 64, stride=1, norm=self.norm,
+                   dtype=self.dtype, name="stage1")(x)
         if self.freeze_at >= 2:
             c2 = jax.lax.stop_gradient(c2)
-        c3 = ResNetStage(blocks[1], 128, stride=2, norm=self.norm,
-                         dtype=self.dtype, name="stage2")(c2)
-        c4 = ResNetStage(blocks[2], 256, stride=2, norm=self.norm,
-                         dtype=self.dtype, name="stage3")(c3)
-        c5 = ResNetStage(blocks[3], 512, stride=2, norm=self.norm,
-                         dtype=self.dtype, name="stage4")(c4)
+        c3 = Stage(blocks[1], 128, stride=2, norm=self.norm,
+                   dtype=self.dtype, name="stage2")(c2)
+        c4 = Stage(blocks[2], 256, stride=2, norm=self.norm,
+                   dtype=self.dtype, name="stage3")(c3)
+        c5 = Stage(blocks[3], 512, stride=2, norm=self.norm,
+                   dtype=self.dtype, name="stage4")(c4)
         return c2, c3, c4, c5
 
 

@@ -136,6 +136,7 @@ class DETR(nn.Module):
     norm: str = "frozen_bn"
     freeze_at: int = 2
     dtype: Dtype = jnp.bfloat16
+    remat: bool = False
 
     @nn.compact
     def __call__(self, images: jnp.ndarray):
@@ -145,7 +146,7 @@ class DETR(nn.Module):
         """
         feats = ResNetStages(depth=self.depth, freeze_at=self.freeze_at,
                              norm=self.norm, dtype=self.dtype,
-                             name="backbone")(images)
+                             remat=self.remat, name="backbone")(images)
         c5 = feats[3]  # stride 32
         b, h, w, _ = c5.shape
         x = nn.Conv(self.hidden, (1, 1), dtype=self.dtype,
@@ -310,6 +311,7 @@ def build_detr_model(cfg: Config) -> DETR:
         norm=cfg.network.norm,
         freeze_at=cfg.network.freeze_at,
         dtype=jnp.dtype(cfg.network.compute_dtype),
+        remat=cfg.network.remat,
     )
 
 

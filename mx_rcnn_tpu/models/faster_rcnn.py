@@ -55,15 +55,22 @@ class FasterRCNN(nn.Module):
     norm: str = "frozen_bn"
     freeze_at: int = 2
     dtype: Any = jnp.bfloat16
+    remat: bool = False
 
     def setup(self):
         if self.backbone.startswith("resnet"):
             depth = int(self.backbone.replace("resnet", ""))
             self.features = ResNetC4(depth=depth, freeze_at=self.freeze_at,
-                                     norm=self.norm, dtype=self.dtype)
+                                     norm=self.norm, dtype=self.dtype,
+                                     remat=self.remat)
             self.head = ResNetHead(depth=depth, norm=self.norm,
                                    dtype=self.dtype)
         elif self.backbone == "vgg":
+            if self.remat:
+                from mx_rcnn_tpu.logger import logger
+
+                logger.warning("network.remat is not implemented for the "
+                               "VGG backbone; running without remat")
             self.features = VGGConv(dtype=self.dtype)
             self.head = VGGHead(dtype=self.dtype)
         else:
@@ -458,6 +465,7 @@ def build_model(cfg: Config) -> FasterRCNN:
         norm=cfg.network.norm,
         freeze_at=cfg.network.freeze_at,
         dtype=jnp.dtype(cfg.network.compute_dtype),
+        remat=cfg.network.remat,
     )
 
 

@@ -157,11 +157,13 @@ class FPNFasterRCNN(nn.Module):
     norm: str = "frozen_bn"
     freeze_at: int = 2
     dtype: Dtype = jnp.bfloat16
+    remat: bool = False
 
     def setup(self):
         self.features = ResNetStages(depth=self.depth,
                                      freeze_at=self.freeze_at,
-                                     norm=self.norm, dtype=self.dtype)
+                                     norm=self.norm, dtype=self.dtype,
+                                     remat=self.remat)
         self.neck = FPNNeck(channels=self.fpn_channels, dtype=self.dtype)
         self.rpn = RPNHead(num_anchors=self.num_anchors,
                            channels=self.fpn_channels, dtype=self.dtype)
@@ -582,6 +584,7 @@ def build_fpn_model(cfg: Config) -> FPNFasterRCNN:
         norm=cfg.network.norm,
         freeze_at=cfg.network.freeze_at,
         dtype=jnp.dtype(cfg.network.compute_dtype),
+        remat=cfg.network.remat,
     )
 
 

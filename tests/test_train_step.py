@@ -155,3 +155,60 @@ def test_dp_grads_match_single_device():
     for a, b in zip(l1, l2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
                                    atol=2e-5)
+
+
+def test_remat_matches_no_remat():
+    """network.remat=True (jax.checkpoint on ResNet stages) must give the
+    same loss and gradients as the plain backbone, with an identical
+    parameter tree (checkpoints are interchangeable)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.models import zoo
+
+    def cfg_for(remat):
+        return generate_config("resnet50", "synthetic", **{
+            "image.pad_shape": (128, 128),
+            "network.norm": "group",
+            "network.freeze_at": 0,
+            "network.remat": remat,
+            "network.anchor_scales": (2, 4, 8),
+            "train.rpn_pre_nms_top_n": 256,
+            "train.rpn_post_nms_top_n": 64,
+            "train.batch_rois": 16,
+            "train.max_gt_boxes": 8,
+        })
+
+    rs = np.random.RandomState(0)
+    batch = {
+        "image": jnp.asarray(rs.randn(1, 128, 128, 3).astype(np.float32)),
+        "im_info": jnp.asarray([[128, 128, 1.0]], np.float32),
+        "gt_boxes": jnp.asarray(
+            [[[10, 10, 60, 90], [70, 20, 120, 70]] + [[0, 0, 0, 0]] * 6],
+            np.float32),
+        "gt_classes": jnp.asarray([[1, 2] + [0] * 6], np.int32),
+        "gt_valid": jnp.asarray([[True, True] + [False] * 6]),
+    }
+    cfg_plain, cfg_remat = cfg_for(False), cfg_for(True)
+    model_plain = zoo.build_model(cfg_plain)
+    model_remat = zoo.build_model(cfg_remat)
+    params = zoo.init_params(model_plain, cfg_plain, jax.random.PRNGKey(0))
+    # identical parameter tree -> same params load into the remat model
+    params_r = zoo.init_params(model_remat, cfg_remat, jax.random.PRNGKey(0))
+    assert jax.tree.structure(params) == jax.tree.structure(params_r)
+
+    key = jax.random.PRNGKey(1)
+
+    def loss_fn(model, cfg):
+        return lambda p: zoo.forward_train(model, p, batch, key, cfg)[0]
+
+    l_plain, g_plain = jax.value_and_grad(loss_fn(model_plain, cfg_plain))(params)
+    l_remat, g_remat = jax.value_and_grad(loss_fn(model_remat, cfg_remat))(params)
+    assert np.isclose(float(l_plain), float(l_remat), rtol=1e-5)
+    flat_p = jax.tree.leaves(g_plain)
+    flat_r = jax.tree.leaves(g_remat)
+    for a, b in zip(flat_p, flat_r):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-4, atol=1e-5)
