@@ -60,21 +60,25 @@ def _metrics_from_aux(aux: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     """
     eps = 1e-12
     out = {"TotalLoss": aux["total_loss"]}
+    # Loss slots track the aux losses directly; accuracy slots need the
+    # logits/labels too. DETR emits rcnn_* losses without logits
+    # (models/detr.py metric-slot reuse), so the two are gated separately.
+    if "rpn_cls_loss" in aux:
+        out["RPNLogLoss"] = aux["rpn_cls_loss"]
+        out["RPNL1Loss"] = aux["rpn_bbox_loss"]
     if "rpn_logits" in aux:
         rpn_pred = jnp.argmax(aux["rpn_logits"], axis=-1)
         rpn_valid = aux["rpn_labels"] >= 0
         rpn_correct = (rpn_pred == aux["rpn_labels"]) & rpn_valid
         out["RPNAcc"] = jnp.sum(rpn_correct) / (jnp.sum(rpn_valid) + eps)
-        out["RPNLogLoss"] = aux["rpn_cls_loss"]
-        out["RPNL1Loss"] = aux["rpn_bbox_loss"]
+    if "rcnn_cls_loss" in aux:
+        out["RCNNLogLoss"] = aux["rcnn_cls_loss"]
+        out["RCNNL1Loss"] = aux["rcnn_bbox_loss"]
     if "rcnn_logits" in aux:
         rcnn_pred = jnp.argmax(aux["rcnn_logits"], axis=-1)
         rcnn_valid = aux["rcnn_labels"] >= 0
         rcnn_correct = (rcnn_pred == aux["rcnn_labels"]) & rcnn_valid
         out["RCNNAcc"] = jnp.sum(rcnn_correct) / (jnp.sum(rcnn_valid) + eps)
-        out["RCNNLogLoss"] = aux["rcnn_cls_loss"]
-        out["RCNNL1Loss"] = aux["rcnn_bbox_loss"]
-        out["NumFg"] = aux["num_fg"].astype(jnp.float32)
     return out
 
 
