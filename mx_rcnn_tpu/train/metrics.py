@@ -33,8 +33,7 @@ class MetricBag:
     def reset(self):
         self._pending = []
         self._sums = {n: 0.0 for n in self.names}
-        self._seen = set()
-        self._count = 0
+        self._counts = {n: 0 for n in self.names}
 
     def update(self, metrics: Dict):
         self._pending.append(metrics)
@@ -44,21 +43,22 @@ class MetricBag:
             for n in self.names:
                 if n in m:
                     self._sums[n] += float(m[n])
-                    self._seen.add(n)
-            self._count += 1
+                    self._counts[n] += 1
         self._pending = []
 
     def get(self) -> Dict[str, float]:
-        """Running means of the metrics ACTUALLY SEEN — a model family
-        that doesn't emit a slot (DETR has no RPN) doesn't log zeros
-        for it. A bag that received no updates at all reports every slot
-        as 0.0 (so fixed-key consumers never KeyError on an empty epoch).
-        """
+        """Per-slot running means of the metrics ACTUALLY SEEN — each slot
+        averages over the updates that carried it (the reference
+        EvalMetrics' (sum_metric, num_inst) semantics), so a model family
+        that doesn't emit a slot (DETR has no RPN) doesn't log zeros for
+        it and an intermittent slot isn't diluted. A bag that received no
+        updates at all reports every slot as 0.0 (fixed-key consumers
+        never KeyError on an empty epoch)."""
         self._drain()
-        if not self._seen:
+        if not any(self._counts.values()):
             return {n: 0.0 for n in self.names}
-        c = max(self._count, 1)
-        return {n: self._sums[n] / c for n in self.names if n in self._seen}
+        return {n: self._sums[n] / c
+                for n in self.names if (c := self._counts[n]) > 0}
 
     def format(self) -> str:
         return "\t".join(f"Train-{n}={v:.6f}" for n, v in self.get().items())

@@ -1,0 +1,78 @@
+"""MetricBag accumulation semantics (train/metrics.py).
+
+The reference's six EvalMetrics (rcnn/core/metric.py) keep (sum, count)
+running averages printed by Speedometer; MetricBag is the lazy host-side
+analog. These tests pin the family-aware slot reporting added for DETR.
+"""
+
+import numpy as np
+
+from mx_rcnn_tpu.train.metrics import METRIC_NAMES, MetricBag
+
+
+def test_running_means():
+    bag = MetricBag()
+    bag.update({"TotalLoss": 2.0, "RPNAcc": 0.5})
+    bag.update({"TotalLoss": 4.0, "RPNAcc": 1.0})
+    got = bag.get()
+    assert got["TotalLoss"] == 3.0
+    assert got["RPNAcc"] == 0.75
+
+
+def test_unseen_slots_are_omitted():
+    """A family that never emits a slot (DETR: no RPN, no accuracies)
+    must not log zeros for it."""
+    bag = MetricBag()
+    bag.update({"TotalLoss": 5.0, "RCNNLogLoss": 0.7, "RCNNL1Loss": 4.3})
+    got = bag.get()
+    assert set(got) == {"TotalLoss", "RCNNLogLoss", "RCNNL1Loss"}
+    assert "RPNAcc" not in got and "RCNNAcc" not in got
+
+
+def test_empty_bag_returns_zero_filled():
+    """No updates at all (empty epoch): fixed-key consumers still find
+    every named slot, at 0.0 — never a KeyError."""
+    bag = MetricBag()
+    got = bag.get()
+    assert set(got) == set(METRIC_NAMES)
+    assert all(v == 0.0 for v in got.values())
+
+
+def test_intermittent_slot_uses_per_slot_count():
+    """A slot present in only some updates averages over THOSE updates
+    (the reference EvalMetrics' (sum, count) pairs), not the global
+    update count — no dilution."""
+    bag = MetricBag()
+    bag.update({"TotalLoss": 2.0, "RPNAcc": 0.5})
+    bag.update({"TotalLoss": 4.0})
+    got = bag.get()
+    assert got["TotalLoss"] == 3.0
+    assert got["RPNAcc"] == 0.5  # 0.5/1, not 0.5/2
+
+
+def test_reset_clears_seen_and_sums():
+    bag = MetricBag()
+    bag.update({"TotalLoss": 2.0})
+    bag.get()
+    bag.reset()
+    assert bag.get()["TotalLoss"] == 0.0  # back to the empty-bag shape
+    bag.update({"RPNLogLoss": 1.0})
+    assert set(bag.get()) == {"RPNLogLoss"}
+
+
+def test_lazy_drain_accepts_device_scalars():
+    """update() must not force conversion; get() converts anything
+    float()-able (device scalars, 0-d numpy)."""
+    bag = MetricBag()
+    bag.update({"TotalLoss": np.float32(1.5)})
+    bag.update({"TotalLoss": np.asarray(2.5)})
+    assert bag.get()["TotalLoss"] == 2.0
+
+
+def test_format_is_speedometer_style():
+    bag = MetricBag()
+    bag.update({"TotalLoss": 1.0, "RPNAcc": 0.5})
+    s = bag.format()
+    assert "Train-TotalLoss=1.000000" in s
+    assert "Train-RPNAcc=0.500000" in s
+    assert "RCNNAcc" not in s
