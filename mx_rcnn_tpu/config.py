@@ -143,6 +143,9 @@ class TrainConfig:
     detr_cost_class: float = 1.0
     detr_cost_l1: float = 5.0
     detr_cost_giou: float = 2.0
+    # Auxiliary decoding losses: the matched set loss at EVERY decoder
+    # layer through shared heads (Carion et al. §3.2).
+    detr_aux_loss: bool = True
     # end2end switch retained for the alternate-training tools.
     end2end: bool = True
 
@@ -180,6 +183,10 @@ class DatasetConfig:
     test_image_set: str = "val2017"
     num_classes: int = 81  # incl. background
     class_names: tuple = ()
+    # Extra get_dataset(...) kwargs as (key, value) pairs — kept a tuple so
+    # the frozen config stays hashable (e.g. synthetic dataset sizing:
+    # (("num_images", 8), ("image_size", 128))).
+    kwargs: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -192,6 +199,14 @@ class ImageConfig:
     # Static padded shape (H, W) every image batch is padded to. Must be a
     # multiple of the max feature stride. 1024 covers the (600,1000) scale.
     pad_shape: tuple = (1024, 1024)
+    # Multi-scale training (BASELINE config 3): one (H, W) pad bucket per
+    # entry of `scales`. Used ONLY when len(pad_shapes) == len(scales)
+    # (so a test overriding scales alone falls back to pad_shape); each
+    # bucket is its own static shape → its own jit compile of the train
+    # step (documented cost: one extra compile per extra scale). The
+    # loader samples one scale PER BATCH — the per-image random scale of
+    # reference-lineage forks would break the single static batch shape.
+    pad_shapes: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -269,6 +284,23 @@ _NETWORK_PRESETS: Mapping[str, Mapping[str, Any]] = {
     "detr_r50": dict(name="detr_r50", depth=50, use_detr=True),
 }
 
+# Per-network ImageConfig presets. The FPN/Mask configs default to the
+# BASELINE-config-3 multi-scale recipe: short side sampled per batch from
+# {640, 800}. Buckets are stored landscape-oriented (short, long) in
+# stride-32 multiples (exact FPN top-down upsample-and-add shapes); the
+# loader transposes them for portrait batches and squares only the rare
+# mixed-orientation seam batch (loader.resolve_pad_bucket) — square-only
+# covers would waste ~60% of the conv FLOPs on landscape COCO batches.
+_IMAGE_PRESETS: Mapping[str, Mapping[str, Any]] = {
+    name: dict(
+        scales=((640, 1066), (800, 1333)),
+        pad_shapes=((672, 1088), (832, 1344)),
+        pad_shape=(1344, 1344),
+    )
+    for name in ("resnet50_fpn", "resnet101_fpn",
+                 "resnet50_fpn_mask", "resnet101_fpn_mask")
+}
+
 VOC_CLASSES = (
     "__background__",
     "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
@@ -316,8 +348,18 @@ def generate_config(network: str, dataset: str, **overrides) -> Config:
     cfg = Config(
         network=NetworkConfig(**_NETWORK_PRESETS[network]),
         dataset=DatasetConfig(**_DATASET_PRESETS[dataset]),
+        image=ImageConfig(**_IMAGE_PRESETS.get(network, {})),
     )
     if overrides:
+        # Overriding scales or pad_shape without pad_shapes must not pair
+        # with the preset's stale buckets: a pad_shape override would be
+        # silently ignored while len(pad_shapes) == len(scales), and a
+        # scales override of the same length would keep too-small buckets
+        # that overflow mid-epoch. Dropping the preset buckets falls back
+        # to the single pad_shape (loader.pad_shape_for).
+        if (("image.scales" in overrides or "image.pad_shape" in overrides)
+                and "image.pad_shapes" not in overrides):
+            overrides = dict(overrides, **{"image.pad_shapes": ()})
         cfg = _apply_dotted_overrides(cfg, overrides)
     return cfg
 

@@ -25,4 +25,13 @@ def get_dataset(name: str, image_set: str, root_path: str, dataset_path: str,
     return registry[name](image_set, root_path, dataset_path, **kwargs)
 
 
-__all__ = ["IMDB", "PascalVOC", "COCODataset", "SyntheticDataset", "get_dataset"]
+def dataset_from_config(ds_cfg, image_set: str = None) -> IMDB:
+    """get_dataset driven by a DatasetConfig, including its extra
+    ``kwargs`` pairs (e.g. synthetic dataset sizing)."""
+    return get_dataset(ds_cfg.name, image_set or ds_cfg.image_set,
+                       ds_cfg.root_path, ds_cfg.dataset_path,
+                       **dict(ds_cfg.kwargs))
+
+
+__all__ = ["IMDB", "PascalVOC", "COCODataset", "SyntheticDataset",
+           "get_dataset", "dataset_from_config"]

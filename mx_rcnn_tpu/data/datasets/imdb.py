@@ -95,13 +95,35 @@ class IMDB:
     def rpn_roidb(self, gt_roidb: List[Dict], rpn_file: str) -> List[Dict]:
         """Merge RPN proposals with gt into a Fast-RCNN-trainable roidb
         (reference: imdb.rpn_roidb + merge_roidbs)."""
-        boxes_list = self.load_rpn_data(rpn_file)
+        return self.proposal_roidb(gt_roidb, self.load_rpn_data(rpn_file))
+
+    def load_proposal_roidb(self, gt_roidb: List[Dict],
+                            proposal_file: str) -> List[Dict]:
+        """Fast R-CNN path over EXTERNAL (e.g. selective-search) proposals
+        (reference: rcnn/utils/load_data.py::load_proposal_roidb over
+        rcnn/dataset selective_search pickles). The pickle holds one
+        (n, 4) or (n, 5) [x1,y1,x2,y2(,score)] array per image, original
+        coordinates, image order matching gt_roidb."""
+        with open(proposal_file, "rb") as f:
+            boxes_list = pickle.load(f)
+        return self.proposal_roidb(gt_roidb, boxes_list)
+
+    def proposal_roidb(self, gt_roidb: List[Dict],
+                       boxes_list: List[np.ndarray]) -> List[Dict]:
+        """Attach per-image proposal arrays ((n,4) or (n,5); an optional
+        trailing score column is dropped) to copies of the gt entries."""
         assert len(boxes_list) == len(gt_roidb), (
             f"proposal count {len(boxes_list)} != roidb {len(gt_roidb)}")
         out = []
         for entry, prop in zip(gt_roidb, boxes_list):
+            prop = np.asarray(prop, np.float32)
+            if prop.size == 0:
+                prop = prop.reshape(0, 4)
+            if prop.ndim != 2 or prop.shape[1] not in (4, 5):
+                raise ValueError(
+                    f"proposal arrays must be (n,4) or (n,5); got {prop.shape}")
             e = dict(entry)
-            e["proposals"] = prop[:, :4].astype(np.float32)
+            e["proposals"] = prop[:, :4]
             out.append(e)
         return out
 

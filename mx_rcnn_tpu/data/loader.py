@@ -32,9 +32,41 @@ from mx_rcnn_tpu.data.image import (
 )
 
 
-def _load_roidb_entry(entry: Dict, cfg: Config):
+def pad_shape_for(cfg: Config, scale_idx: int) -> tuple:
+    """The static pad bucket for scale `scale_idx`: image.pad_shapes when it
+    matches image.scales entry-for-entry, else the single image.pad_shape
+    (so overriding scales alone never silently pairs with stale buckets).
+
+    A pad_shapes entry is stored LANDSCAPE-oriented ((H, W), H <= W);
+    resolve_pad_bucket orients it per batch."""
+    if len(cfg.image.pad_shapes) == len(cfg.image.scales):
+        return tuple(cfg.image.pad_shapes[scale_idx])
+    return tuple(cfg.image.pad_shape)
+
+
+def resolve_pad_bucket(cfg: Config, scale_idx: int,
+                       landscape_flags: Sequence[bool]) -> tuple:
+    """Orientation-aware bucket for one batch.
+
+    Square-covering both orientations pads the dominant (landscape COCO)
+    batches to ~1.6x their needed pixel area — measurable MFU on the conv
+    hot path. With aspect grouping, batches are orientation-pure except at
+    the group seam, so: all-landscape → (H, W) as stored; all-portrait →
+    transposed; mixed (the rare seam batch) → the square cover. At most 3
+    static shapes per scale, compiled once each."""
+    h, w = pad_shape_for(cfg, scale_idx)
+    h, w = min(h, w), max(h, w)  # normalize to landscape orientation
+    if all(landscape_flags):
+        return (h, w)
+    if not any(landscape_flags):
+        return (w, h)
+    return (w, w)
+
+
+def _load_roidb_entry(entry: Dict, cfg: Config, scale_idx: int = 0,
+                      pad: Optional[tuple] = None):
     """roidb record → (padded image f32 HWC, im_info, boxes, classes) at the
-    training scale. Handles the `flipped` flag the imdb sets."""
+    chosen training scale. Handles the `flipped` flag the imdb sets."""
     if "image_data" in entry:  # synthetic datasets embed pixels directly
         img = entry["image_data"].astype(np.float32)
     else:
@@ -42,12 +74,13 @@ def _load_roidb_entry(entry: Dict, cfg: Config):
     boxes = entry["boxes"].astype(np.float32).copy()
     if entry.get("flipped"):
         img, boxes = flip_image_and_boxes(img, boxes)
-    target, max_size = cfg.image.scales[0]
+    target, max_size = cfg.image.scales[scale_idx]
     img, scale = resize_image(img, target, max_size)
     boxes *= scale
     h, w = img.shape[:2]
     img = transform_image(img, cfg.image.pixel_means, cfg.image.pixel_stds)
-    img = pad_image(img, cfg.image.pad_shape)
+    img = pad_image(img, pad if pad is not None
+                    else pad_shape_for(cfg, scale_idx))
     im_info = np.asarray([h, w, scale], np.float32)
     return img, im_info, boxes, entry["gt_classes"].astype(np.int32)
 
@@ -225,15 +258,20 @@ class AnchorLoader:
         self._rng.shuffle(inds)
         return inds
 
-    def _make_batch(self, idxs: np.ndarray) -> Dict[str, np.ndarray]:
+    def _make_batch(self, item) -> Dict[str, np.ndarray]:
+        idxs, scale_idx = item
         cfg = self.cfg
         g = cfg.train.max_gt_boxes
         with_masks = cfg.network.use_mask
         m = cfg.train.mask_gt_resolution
+        pad = resolve_pad_bucket(cfg, scale_idx, [
+            self.roidb[i].get("width", 1) >= self.roidb[i].get("height", 1)
+            for i in idxs])
         imgs, infos, gtb, gtc, gtv, gtm = [], [], [], [], [], []
         for i in idxs:
             entry = self.roidb[i]
-            img, info, boxes, classes = _load_roidb_entry(entry, cfg)
+            img, info, boxes, classes = _load_roidb_entry(entry, cfg,
+                                                          scale_idx, pad)
             b, c, v = _pad_gt(boxes, classes, g)
             imgs.append(img)
             infos.append(info)
@@ -262,7 +300,14 @@ class AnchorLoader:
         # global batch (same order on every process — same seed).
         lo = self.process_index * self.batch_size
         batches = batches[:, lo:lo + self.batch_size]
-        it = _PrefetchIterator(self._make_batch, batches,
+        # Multi-scale: one scale bucket per GLOBAL batch (drawn from the
+        # shared-seed rng AFTER the order draw, so every host picks the
+        # same buckets). Each distinct bucket is one static shape.
+        n_scales = len(self.cfg.image.scales)
+        scale_ids = (self._rng.randint(n_scales, size=nb) if n_scales > 1
+                     else np.zeros(nb, np.int64))
+        items = [(batches[i], int(scale_ids[i])) for i in range(nb)]
+        it = _PrefetchIterator(self._make_batch, items,
                                depth=self._depth, workers=self._workers)
         try:
             yield from it
@@ -283,8 +328,9 @@ class ROIIter(AnchorLoader):
         super().__init__(roidb, cfg, num_shards, **kw)
         self.max_proposals = max_proposals
 
-    def _make_batch(self, idxs: np.ndarray) -> Dict[str, np.ndarray]:
-        batch = super()._make_batch(idxs)
+    def _make_batch(self, item) -> Dict[str, np.ndarray]:
+        idxs, _scale_idx = item
+        batch = super()._make_batch(item)
         p = self.max_proposals
         props = np.zeros((len(idxs), p, 4), np.float32)
         pvalid = np.zeros((len(idxs), p), bool)
@@ -314,6 +360,8 @@ class TestLoader:
     size for mapping detections back to original image coordinates.
     """
 
+    __test__ = False  # pytest: not a test class, despite the name
+
     def __init__(self, roidb: List[Dict], cfg: Config, batch_size: int = 1,
                  prefetch_depth: int = 4, workers: int = 2):
         self.roidb = roidb
@@ -327,6 +375,13 @@ class TestLoader:
 
     def _make_batch(self, idxs):
         cfg = self.cfg
+        # Inference uses ONE scale — the last (largest) entry, the
+        # reference's TEST.SCALE convention under multi-scale training.
+        scale_idx = len(cfg.image.scales) - 1
+        real_idxs = [i if i >= 0 else len(self.roidb) - 1 for i in idxs]
+        pad = resolve_pad_bucket(cfg, scale_idx, [
+            self.roidb[i].get("width", 1) >= self.roidb[i].get("height", 1)
+            for i in real_idxs])
         imgs, infos, metas = [], [], []
         for i in idxs:
             if i < 0:  # tail padding repeats the last real image
@@ -337,7 +392,8 @@ class TestLoader:
             entry = self.roidb[i]
             img, info, _, _ = _load_roidb_entry(
                 {**entry, "boxes": np.zeros((0, 4), np.float32),
-                 "gt_classes": np.zeros((0,), np.int32)}, cfg)
+                 "gt_classes": np.zeros((0,), np.int32)}, cfg, scale_idx,
+                pad)
             imgs.append(img)
             infos.append(info)
             metas.append({"index": i, "scale": float(info[2]), "real": real})

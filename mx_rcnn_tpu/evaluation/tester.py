@@ -116,13 +116,20 @@ def _split_packed(packed: np.ndarray, scale: float) -> List[np.ndarray]:
 def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
               vis: bool = False, thresh: float = 0.0,
               out_json: Optional[str] = None,
-              vis_dir: str = "vis") -> Dict[str, float]:
+              vis_dir: str = "vis", pipeline_depth: int = 3) -> Dict[str, float]:
     """Evaluate over an imdb (reference: tester.py::pred_eval).
 
     Builds all_boxes[class][image] = (n, 5) [x1..y2, score] in original
     coords and hands it to imdb.evaluate_detections. vis=True writes box
     overlays (score ≥ 0.5) to vis_dir, as the reference's vis branch shows
     them interactively.
+
+    pipeline_depth: how many batches of device work stay enqueued before
+    the oldest result is read back. Through the remote-relay device the
+    read is round-trip-latency-bound, so deeper pipelining (with
+    batch_size > 1 in the loader) amortizes it. 1 = fully serial
+    (enqueue, then immediately read); 2 ≈ the previous fixed 1-in-flight
+    pipeline.
     """
     num_classes = imdb.num_classes
     num_images = len(test_loader.roidb)
@@ -171,16 +178,19 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
         if done % 100 < len(metas):
             logger.info("im_detect: %d/%d", done, num_images)
 
-    # 1-deep pipeline: enqueue batch i+1's detect before reading batch i's
-    # results, so host post-processing and device compute overlap.
-    pending = None
+    # N-deep pipeline: keep up to pipeline_depth batches of device work
+    # in flight before reading the oldest result, so host post-processing
+    # and relay round trips overlap device compute.
+    from collections import deque
+
+    pending = deque()
     for batch, metas in test_loader:
-        dev_packed = predictor.detect(batch["image"], batch["im_info"])
-        if pending is not None:
-            _process(*pending)
-        pending = (dev_packed, batch, metas)
-    if pending is not None:
-        _process(*pending)
+        pending.append((predictor.detect(batch["image"], batch["im_info"]),
+                        batch, metas))
+        if len(pending) >= max(1, pipeline_depth):
+            _process(*pending.popleft())
+    while pending:
+        _process(*pending.popleft())
     kwargs = {}
     if out_json:
         kwargs["out_json"] = out_json

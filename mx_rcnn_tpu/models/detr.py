@@ -139,10 +139,15 @@ class DETR(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, images: jnp.ndarray):
+    def __call__(self, images: jnp.ndarray, aux_outputs: bool = False):
         """images (B, H, W, 3) → (logits (B, Q, C), boxes (B, Q, 4)).
 
         boxes are (cx, cy, w, h) in [0, 1] of the PADDED canvas.
+
+        aux_outputs=True returns every decoder layer's predictions instead
+        — (L, B, Q, C) / (L, B, Q, 4), final layer last — through the SAME
+        norm + prediction heads (Carion et al. §3.2 auxiliary decoding
+        losses use shared heads across layers).
         """
         feats = ResNetStages(depth=self.depth, freeze_at=self.freeze_at,
                              norm=self.norm, dtype=self.dtype,
@@ -164,21 +169,29 @@ class DETR(nn.Module):
             query_pos[None], (b, self.num_queries, self.hidden)).astype(
                 x.dtype)
         tgt = jnp.zeros_like(query_pos)
+        layer_out = []
         for i in range(self.dec_layers):
             tgt = DecoderLayer(self.hidden, self.heads, dtype=self.dtype,
                                name=f"dec{i}")(tgt, query_pos, x, pos)
-        tgt = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
-                           name="dec_norm")(tgt)
+            layer_out.append(tgt)
+        # (L, B, Q, H) or (1, B, Q, H): the heads below act on the last
+        # axis only, so one application covers all layers with one set of
+        # shared parameters either way.
+        hs = jnp.stack(layer_out if aux_outputs else layer_out[-1:])
+        hs = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                          name="dec_norm")(hs)
         logits = nn.Dense(self.num_classes, dtype=jnp.float32,
                           param_dtype=jnp.float32, name="class_embed")(
-                              tgt.astype(jnp.float32))
-        y = tgt.astype(jnp.float32)
+                              hs.astype(jnp.float32))
+        y = hs.astype(jnp.float32)
         for i in range(2):
             y = nn.relu(nn.Dense(self.hidden, dtype=jnp.float32,
                                  name=f"bbox_mlp{i}")(y))
         boxes = jax.nn.sigmoid(
             nn.Dense(4, dtype=jnp.float32, name="bbox_out")(y))
-        return logits, boxes
+        if aux_outputs:
+            return logits, boxes
+        return logits[0], boxes[0]
 
 
 # ---------------------------------------------------------------------------
@@ -256,29 +269,41 @@ def forward_train(model: DETR, params, batch: Dict[str, jnp.ndarray],
     """DETR train forward — same batch contract as the other families."""
     images = batch["image"]
     b, hh, ww, _ = images.shape
-    logits, boxes = model.apply(params, images)
+    use_aux = cfg.train.detr_aux_loss
+    # (L, B, Q, ·): every decoder layer's predictions through the shared
+    # heads (Carion et al. §3.2 — the per-layer losses are reported as
+    # important for convergence); L=1 (final layer only) when disabled.
+    logits_all, boxes_all = model.apply(params, images, aux_outputs=use_aux)
+    if not use_aux:
+        logits_all, boxes_all = logits_all[None], boxes_all[None]
     scale = jnp.asarray([ww, hh, ww, hh], jnp.float32)
     gt_n = batch["gt_boxes"] / scale  # normalized xyxy
 
+    per_image = lambda lg, bx, g, c, v: _one_image_loss(  # noqa: E731
+        lg, bx, g, c, v,
+        eos_coef=cfg.train.detr_eos_coef,
+        cost_class=cfg.train.detr_cost_class,
+        cost_l1=cfg.train.detr_cost_l1,
+        cost_giou=cfg.train.detr_cost_giou)
+    # outer vmap: decoder layers (each re-matched, as in the paper);
+    # inner vmap: batch. Shapes (L, B).
     cls_l, l1_l, giou_l, acc, nmatch = jax.vmap(
-        lambda lg, bx, g, c, v: _one_image_loss(
-            lg, bx, g, c, v,
-            eos_coef=cfg.train.detr_eos_coef,
-            cost_class=cfg.train.detr_cost_class,
-            cost_l1=cfg.train.detr_cost_l1,
-            cost_giou=cfg.train.detr_cost_giou)
-    )(logits, boxes, gt_n, batch["gt_classes"], batch["gt_valid"])
+        lambda lg, bx: jax.vmap(per_image)(
+            lg, bx, gt_n, batch["gt_classes"], batch["gt_valid"])
+    )(logits_all, boxes_all)
 
-    cls_loss = jnp.mean(cls_l)
-    l1_loss = jnp.mean(l1_l) * cfg.train.detr_cost_l1
-    giou_loss = jnp.mean(giou_l) * cfg.train.detr_cost_giou
-    total = cls_loss + l1_loss + giou_loss
+    cls_per_layer = jnp.mean(cls_l, axis=1)                          # (L,)
+    l1_per_layer = jnp.mean(l1_l, axis=1) * cfg.train.detr_cost_l1
+    giou_per_layer = jnp.mean(giou_l, axis=1) * cfg.train.detr_cost_giou
+    total = jnp.sum(cls_per_layer + l1_per_layer + giou_per_layer)
     aux = {
-        "rcnn_cls_loss": cls_loss,   # metric-slot reuse (MetricBag names)
-        "rcnn_bbox_loss": l1_loss + giou_loss,
-        "detr_giou_loss": giou_loss,
+        # metric slots report the FINAL layer (comparable across configs);
+        # total_loss carries the aux sum actually optimized.
+        "rcnn_cls_loss": cls_per_layer[-1],
+        "rcnn_bbox_loss": l1_per_layer[-1] + giou_per_layer[-1],
+        "detr_giou_loss": giou_per_layer[-1],
         "total_loss": total,
-        "num_fg": jnp.sum(nmatch).astype(jnp.float32),
+        "num_fg": jnp.sum(nmatch[-1]).astype(jnp.float32),
     }
     return total, aux
 

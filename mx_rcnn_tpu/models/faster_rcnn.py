@@ -71,7 +71,11 @@ class FasterRCNN(nn.Module):
 
                 logger.warning("network.remat is not implemented for the "
                                "VGG backbone; running without remat")
-            self.features = VGGConv(dtype=self.dtype)
+            # freeze_at=0 (from-scratch profile) unfreezes conv1-2 too;
+            # any other value keeps the reference's conv1-2 cut.
+            self.features = VGGConv(
+                freeze_blocks=0 if self.freeze_at == 0 else 2,
+                dtype=self.dtype)
             self.head = VGGHead(dtype=self.dtype)
         else:
             raise ValueError(f"unknown backbone {self.backbone!r}")

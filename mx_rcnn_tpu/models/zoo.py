@@ -65,8 +65,16 @@ def build_model(cfg: Config, mesh=None):
 
         attn_fn = None
         if wants_sp and mesh is not None:
+            if "model" not in mesh.axis_names:
+                # attn_fn shards over axis='model'; without it the failure
+                # would surface later as an opaque unbound-axis error inside
+                # shard_map. Fail at build time with the real cause.
+                raise ValueError(
+                    f"sequence parallelism (sp_mode="
+                    f"{cfg.network.sp_mode!r}) needs a 'model' axis in the "
+                    f"mesh; got axes {mesh.axis_names}. Build the mesh as "
+                    "'<data>x<model>' (e.g. --tpu-mesh 2x4) or disable SP")
             if (cfg.network.sp_mode == "ulysses"
-                    and "model" in mesh.axis_names
                     and cfg.network.vit_heads % mesh.shape["model"] != 0):
                 # Fail at build time, not at first trace.
                 raise ValueError(

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 import jax
 
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.data.datasets import get_dataset
+from mx_rcnn_tpu.data.datasets import dataset_from_config
 from mx_rcnn_tpu.data.loader import ROIIter, TestLoader
 from mx_rcnn_tpu.evaluation.tester import (
     Predictor,
@@ -64,8 +64,7 @@ def test_rpn_generate(cfg: Config, params, rpn_file: str,
     predictor = Predictor(model, params, cfg)
     files = []
     for s in sets:
-        ds = get_dataset(cfg.dataset.name, s, cfg.dataset.root_path,
-                         cfg.dataset.dataset_path)
+        ds = dataset_from_config(cfg.dataset, s)
         roidb = ds.gt_roidb()
         loader = TestLoader(roidb, cfg, batch_size=1)
         f = rpn_file if len(sets) == 1 else f"{rpn_file}.{s}"
@@ -81,8 +80,7 @@ def _attach_proposals(cfg: Config, rpn_file: str) -> List[Dict]:
     sets = image_set.split("+")
     out = []
     for s in sets:
-        ds = get_dataset(cfg.dataset.name, s, cfg.dataset.root_path,
-                         cfg.dataset.dataset_path)
+        ds = dataset_from_config(cfg.dataset, s)
         gt = ds.gt_roidb()
         f = rpn_file if len(sets) == 1 else f"{rpn_file}.{s}"
         merged = ds.rpn_roidb(gt, f)
@@ -124,8 +122,7 @@ def test_rcnn(cfg: Config, prefix: str, epoch: int,
               image_set: Optional[str] = None, thresh: float = 1e-3):
     """Evaluate a checkpoint (reference: tools/test_rcnn.py)."""
     image_set = image_set or cfg.dataset.test_image_set
-    ds = get_dataset(cfg.dataset.name, image_set, cfg.dataset.root_path,
-                     cfg.dataset.dataset_path)
+    ds = dataset_from_config(cfg.dataset, image_set)
     roidb = ds.gt_roidb()
     model = build_model(cfg)
     template = init_params(model, cfg, jax.random.PRNGKey(0))

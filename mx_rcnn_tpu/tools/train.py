@@ -14,7 +14,7 @@ import jax
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.data.datasets import get_dataset
+from mx_rcnn_tpu.data.datasets import dataset_from_config
 from mx_rcnn_tpu.data.datasets.imdb import filter_roidb, merge_roidb
 from mx_rcnn_tpu.data.loader import AnchorLoader
 from mx_rcnn_tpu.logger import logger
@@ -39,8 +39,7 @@ def load_gt_roidbs(cfg: Config, image_set: Optional[str] = None,
     flip = cfg.train.flip if flip is None else flip
     roidbs = []
     for s in image_set.split("+"):
-        ds = get_dataset(cfg.dataset.name, s, cfg.dataset.root_path,
-                         cfg.dataset.dataset_path)
+        ds = dataset_from_config(cfg.dataset, s)
         roidb = ds.gt_roidb()
         if flip:
             roidb = ds.append_flipped_images(roidb)
@@ -57,6 +56,7 @@ def fit_detector(
     frequent: int = 20,
     resume: bool = False,
     pretrained_params=None,
+    pretrained_npz: Optional[str] = None,
     mesh_spec: Optional[str] = None,
     seed: int = 0,
     epoch_callback: Optional[Callable] = None,
@@ -93,6 +93,12 @@ def fit_detector(
     model = build_model(cfg, mesh=mesh)  # mesh: ring attention for ViTDet
     params = pretrained_params or init_params(
         model, cfg, jax.random.PRNGKey(seed))
+    if pretrained_npz:
+        # ImageNet manifest init (reference: load_param over .params —
+        # utils/pretrained.py). Trunk leaves come from the npz; the new
+        # heads keep the fresh init above.
+        from mx_rcnn_tpu.utils.pretrained import import_pretrained
+        params, _ = import_pretrained(pretrained_npz, params)
     if loader_factory is None:
         loader = AnchorLoader(roidb, cfg, num_shards=n_local, seed=seed,
                               process_count=jax.process_count(),

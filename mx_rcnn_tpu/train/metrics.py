@@ -51,12 +51,12 @@ class MetricBag:
         averages over the updates that carried it (the reference
         EvalMetrics' (sum_metric, num_inst) semantics), so a model family
         that doesn't emit a slot (DETR has no RPN) doesn't log zeros for
-        it and an intermittent slot isn't diluted. A bag that received no
-        updates at all reports every slot as 0.0 (fixed-key consumers
-        never KeyError on an empty epoch)."""
+        it and an intermittent slot isn't diluted.
+
+        Contract: slots never seen are OMITTED — including from an empty
+        bag, which returns {} (one rule, no empty-epoch special case).
+        Fixed-key consumers should use ``bag.get().get(name, default)``."""
         self._drain()
-        if not any(self._counts.values()):
-            return {n: 0.0 for n in self.names}
         return {n: self._sums[n] / c
                 for n in self.names if (c := self._counts[n]) > 0}
 

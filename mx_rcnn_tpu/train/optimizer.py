@@ -69,13 +69,45 @@ def lr_schedule(cfg: Config, steps_per_epoch: int,
     return base
 
 
+def effective_fixed_patterns(cfg: Config) -> tuple:
+    """The optimizer-mask patterns implied by the config as a whole.
+
+    The ResNet stem/stage1 patterns exist to mirror the reference's
+    fixed_param_prefix, whose forward-side twin is the freeze_at
+    stop_gradient cut (models/backbones.py). With freeze_at=0 (the
+    from-scratch profile) there is no cut and the stem is MEANT to train —
+    keeping the patterns would freeze it at random init. One knob, one
+    freeze."""
+    pats = tuple(cfg.network.fixed_param_patterns)
+    if cfg.network.freeze_at < 2:
+        # the stage1 cut exists only from freeze_at=2 up
+        pats = tuple(p for p in pats if p != "stage1")
+    if cfg.network.freeze_at == 0:
+        # ResNet stem AND the VGG conv1-2 prefix both unfreeze
+        pats = tuple(p for p in pats
+                     if p not in ("conv0", "bn0")
+                     and not p.startswith(("conv1_", "conv2_")))
+    return pats
+
+
 def build_optimizer(cfg: Config, params, steps_per_epoch: int = 1000,
                     begin_step: int = 0):
-    mask = trainable_mask(params, cfg.network.fixed_param_patterns)
+    mask = trainable_mask(params, effective_fixed_patterns(cfg))
     sched = lr_schedule(cfg, steps_per_epoch, begin_step)
     inner = optax.chain(
         optax.clip(cfg.train.clip_gradient),
         optax.add_decayed_weights(cfg.train.wd),
         optax.sgd(learning_rate=sched, momentum=cfg.train.momentum),
     )
-    return optax.masked(inner, mask)
+    # NOT optax.masked(inner, mask): masked() passes the RAW GRADIENT
+    # through for masked-out leaves (optax's contract), which apply_updates
+    # would then ADD to the frozen params — gradient ascent. Harmless only
+    # when the frozen grads are structurally zero (the stop_gradient-cut C4
+    # prefix), actively wrong for the alternate-training frozen-trunk
+    # stages where grads through `features` are real. Frozen leaves must
+    # get a hard zero update (caught by test_stages.py's trunk-sharing
+    # assertion).
+    labels = jax.tree_util.tree_map(
+        lambda t: "train" if t else "frozen", mask)
+    return optax.multi_transform(
+        {"train": inner, "frozen": optax.set_to_zero()}, labels)

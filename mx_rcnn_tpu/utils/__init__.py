@@ -1,10 +1,11 @@
 """Model/data plumbing utilities.
 
 Reference: rcnn/utils/ — load_data.py (covered by data/datasets + tools),
-load_model.py / save_model.py (covered by train/checkpoint.py),
-combine_model.py (here).
+load_model.py (pretrained.py ImageNet import + train/checkpoint.py),
+save_model.py (train/checkpoint.py), combine_model.py (here).
 """
 
 from mx_rcnn_tpu.utils.combine_model import combine_model
+from mx_rcnn_tpu.utils.pretrained import import_pretrained
 
-__all__ = ["combine_model"]
+__all__ = ["combine_model", "import_pretrained"]

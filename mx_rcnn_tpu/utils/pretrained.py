@@ -1,0 +1,165 @@
+"""Pretrained-weight import: npz manifest ↔ flax param tree.
+
+Reference: rcnn/utils/load_model.py::load_param over ImageNet ``.params``
+files + script/get_pretrained_model.sh (SURVEY.md §3). The reference
+initializes the shared conv trunk (and, for VGG, fc6/fc7) from an ImageNet
+classification checkpoint and random-inits the new heads (rpn_*, cls_score,
+bbox_pred); frozen BatchNorm is only sound with the pretrained moving
+statistics restored. This module is that import path for the TPU build.
+
+## The npz manifest (documented contract — see BASELINE.md)
+
+A pretrained file is a ``.npz`` holding a flat dict: key = ``/``-joined path
+of a param leaf, value = numpy array **already in this build's layouts**
+(conv kernels HWIO, dense kernels (in, out), NHWC flatten order for VGG
+fc6). Keys may be either
+
+- **backbone-relative** (the canonical manifest produced by
+  ``utils/torch_convert.py``): no ``features/`` prefix, e.g.::
+
+      conv0/kernel                      (7,7,3,64)      ResNet stem
+      bn0/gamma|beta|moving_mean|moving_var   (64,)
+      stage{1..4}/block{i}/conv{1,2,3}/kernel
+      stage{1..4}/block{i}/bn{1,2,3}/gamma|beta|moving_mean|moving_var
+      stage{1..4}/block0/downsample_conv/kernel + downsample_bn/*
+      conv{b}_{c}/kernel|bias           VGG-16 13 convs
+      fc6/kernel|bias, fc7/kernel|bias  VGG classifier (reference loads
+                                        these into the detection head too)
+
+  Routing: each key is tried at ``<key>``, ``features/<key>`` then
+  ``head/<key>`` in the detector tree — which places ResNet ``stage4``
+  under ``features/`` for FPN models and under ``head/`` for C4 models,
+  and VGG ``fc6/fc7`` under ``head/``, with no per-family tables.
+
+- **full-tree** paths (``features/...``, ``head/...``, ``rpn/...``, ...):
+  matched verbatim; lets an npz round-trip a whole detector.
+
+Keys with no destination in the template (e.g. the ImageNet ``fc_final``
+classifier, or ResNet ``stage4`` when the model is C4-with-FPN-neck) are
+reported, not fatal. Template leaves the npz does not cover keep their
+fresh initialization — by design for ``rpn_*``/``cls_score``/``bbox_pred``
+(reference behavior), and validated for the backbone: ``strict_backbone``
+(default) raises if any ``features/`` leaf stays uninitialized, since a
+silently half-loaded trunk is the classic silent-mAP-killer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+from flax import traverse_util
+
+from mx_rcnn_tpu.logger import logger
+
+
+def flatten_params(tree: Dict) -> Dict[str, np.ndarray]:
+    """Nested dict tree → {'a/b/c': leaf} (flax flatten_dict, sep='/').
+    No-op on an already-flat manifest dict."""
+    if not tree:
+        return {}
+    return traverse_util.flatten_dict(tree, sep="/")
+
+
+def unflatten_params(flat: Dict[str, np.ndarray]) -> Dict:
+    return traverse_util.unflatten_dict(flat, sep="/")
+
+
+def save_params_npz(path: str, tree_or_flat) -> None:
+    """Save a param tree (or an already-flat manifest dict) as an npz."""
+    flat = flatten_params(tree_or_flat)  # no-op on an already-flat dict
+    np.savez(path, **{k: np.asarray(v) for k, v in flat.items()})
+
+
+def load_params_npz(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path) as data:
+        return {k: data[k] for k in data.files}
+
+
+@dataclass
+class ImportReport:
+    loaded: List[str] = field(default_factory=list)      # template paths set
+    unused: List[str] = field(default_factory=list)      # npz keys w/o a home
+    skipped: List[str] = field(default_factory=list)     # shape-mismatch heads
+    uninitialized: List[str] = field(default_factory=list)  # template leaves kept
+
+    def summary(self) -> str:
+        return (f"loaded {len(self.loaded)} leaves; "
+                f"{len(self.unused)} npz keys unused; "
+                f"{len(self.skipped)} skipped (shape mismatch); "
+                f"{len(self.uninitialized)} template leaves left at init")
+
+
+# New-head leaves the reference random-inits; a class-count mismatch there
+# is expected (ImageNet→COCO), anywhere else it is an error.
+_HEAD_PREFIXES = ("cls_score", "bbox_pred", "rpn/")
+
+
+def import_pretrained(npz_path: str, template: Dict,
+                      strict_backbone: bool = True) -> Tuple[Dict, ImportReport]:
+    """Merge a manifest npz into a fresh param tree (see module docstring).
+
+    template: the ``init_params`` tree (either the bare param dict or one
+    wrapped in {'params': ...}). Returns (params, report) with the same
+    wrapping as the template. Leaf dtypes follow the template.
+    """
+    wrapped = isinstance(template, dict) and set(template) == {"params"}
+    tree = template["params"] if wrapped else template
+    flat = flatten_params(tree)
+    npz = load_params_npz(npz_path)
+
+    report = ImportReport()
+    out = dict(flat)
+    for key, val in sorted(npz.items()):
+        for dest in (key, f"features/{key}", f"head/{key}"):
+            if dest in flat:
+                break
+        else:
+            report.unused.append(key)
+            continue
+        want = np.asarray(flat[dest]).shape
+        if tuple(val.shape) != tuple(want):
+            if any(dest.startswith(p) for p in _HEAD_PREFIXES):
+                # Reference load_param: detection heads with a different
+                # class count keep their fresh init.
+                report.skipped.append(f"{key} -> {dest} "
+                                      f"(npz {val.shape} vs model {want})")
+                continue
+            raise ValueError(
+                f"pretrained import: {npz_path!r} key {key!r} maps to "
+                f"{dest!r} but shapes differ (npz {tuple(val.shape)} vs "
+                f"model {tuple(want)}) — wrong depth/backbone manifest?")
+        out[dest] = np.asarray(val, dtype=np.asarray(flat[dest]).dtype)
+        report.loaded.append(dest)
+
+    loaded_set = set(report.loaded)
+    report.uninitialized = [k for k in flat if k not in loaded_set]
+    # The trunk is everything ImageNet init covers: features/* plus the
+    # C4 stage4 that lives under head/ — a partially initialized trunk
+    # passes training but silently kills mAP. head/fc* is ambiguous (VGG's
+    # fc6/fc7 come from ImageNet; ResNet-FPN's same-named 2-FC box head is
+    # a new head), so an uncovered fc head only warns.
+    missing_bb = [k for k in report.uninitialized
+                  if k.startswith(("features/", "head/stage"))]
+    missing_fc = [k for k in report.uninitialized if k.startswith("head/fc")]
+    if missing_fc and any(k.startswith("fc") for k in npz):
+        logger.warning(
+            "pretrained import: npz provides fc keys but %d head/fc leaves "
+            "stayed at init (e.g. %s) — shape mismatch? For VGG this "
+            "forfeits the ImageNet fc6/fc7 init.", len(missing_fc),
+            missing_fc[:2])
+    if strict_backbone and missing_bb:
+        raise ValueError(
+            f"pretrained import: {len(missing_bb)} backbone leaves not "
+            f"covered by {npz_path!r} (e.g. {missing_bb[:4]}) — a partially "
+            "initialized trunk trains but silently kills mAP. Pass "
+            "strict_backbone=False only if this is intentional.")
+    if not report.loaded:
+        raise ValueError(
+            f"pretrained import: no key in {npz_path!r} matched the model "
+            f"tree (sample npz keys: {sorted(npz)[:4]})")
+    logger.info("pretrained import from %s: %s", npz_path, report.summary())
+
+    merged = unflatten_params(out)
+    return ({"params": merged} if wrapped else merged), report

@@ -1,0 +1,163 @@
+"""Convert torch/torchvision ImageNet checkpoints → the npz manifest.
+
+Reference: script/get_pretrained_model.sh downloads MXNet ``.params``
+ImageNet checkpoints consumed by rcnn/utils/load_model.py::load_param.
+MXNet-format files cannot exist in this environment; the publicly
+obtainable equivalents are torchvision's ``resnet50/101`` and ``vgg16``
+ImageNet state_dicts, so this converter targets that naming scheme
+(plain ``state_dict()`` key/value dicts — a ``.pth`` file or in-memory).
+
+Layout conversions performed (torch → this build):
+- conv weights  (O, I, kH, kW) → HWIO (kH, kW, I, O)
+- linear weights (out, in)     → (in, out)
+- BatchNorm weight/bias/running_mean/running_var
+    → gamma/beta/moving_mean/moving_var
+- VGG fc6: torch flattens pool5 as (C=512, H=7, W=7); this build pools
+  NHWC and flattens as (H, W, C). The input axis is permuted to match —
+  without this the loaded fc6 is a channel-scrambled near-no-op.
+
+Name maps:
+- ResNet: conv1→conv0, bn1→bn0, layer{s}.{i}→stage{s}/block{i},
+  conv{k}/bn{k} kept, downsample.0/.1→downsample_conv/downsample_bn.
+  (fc.* — the ImageNet classifier — is dropped.)
+- VGG-16: features.{0,2,5,7,10,12,14,17,19,21,24,26,28}
+  → conv{1_1 .. 5_3}; classifier.0→fc6, classifier.3→fc7
+  (classifier.6 — the ImageNet classifier — is dropped.)
+
+Usage (CLI)::
+
+    python -m mx_rcnn_tpu.utils.torch_convert resnet101 \
+        resnet101-imagenet.pth model/resnet101.npz
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from mx_rcnn_tpu.utils.pretrained import save_params_npz
+
+# torchvision vgg16 feature-extractor conv layer indices, in order.
+_VGG16_CONV_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+_VGG16_PLAN = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
+_BN_LEAF = {"weight": "gamma", "bias": "beta",
+            "running_mean": "moving_mean", "running_var": "moving_var"}
+
+
+def _np(t) -> np.ndarray:
+    """torch.Tensor or array-like → float32 numpy (host)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _conv(w) -> np.ndarray:
+    return _np(w).transpose(2, 3, 1, 0)  # OIHW -> HWIO
+
+
+def convert_torchvision_resnet(state_dict: Dict) -> Dict[str, np.ndarray]:
+    """torchvision resnet50/101 state_dict → backbone-manifest flat dict."""
+    out: Dict[str, np.ndarray] = {}
+    for key, val in state_dict.items():
+        parts = key.split(".")
+        if parts[-1] == "num_batches_tracked" or parts[0] == "fc":
+            continue
+        if parts[0] == "conv1":
+            out["conv0/kernel"] = _conv(val)
+        elif parts[0] == "bn1":
+            out[f"bn0/{_BN_LEAF[parts[1]]}"] = _np(val)
+        elif parts[0].startswith("layer"):
+            stage = int(parts[0][len("layer"):])
+            base = f"stage{stage}/block{int(parts[1])}"
+            mod = parts[2]
+            if mod.startswith("conv"):
+                out[f"{base}/{mod}/kernel"] = _conv(val)
+            elif mod.startswith("bn"):
+                out[f"{base}/{mod}/{_BN_LEAF[parts[3]]}"] = _np(val)
+            elif mod == "downsample":  # layerS.B.downsample.{0,1}.<leaf>
+                idx, leaf = parts[3], parts[4]
+                if idx == "0":
+                    out[f"{base}/downsample_conv/kernel"] = _conv(val)
+                else:
+                    out[f"{base}/downsample_bn/{_BN_LEAF[leaf]}"] = _np(val)
+            else:
+                raise KeyError(f"unrecognized resnet key {key!r}")
+        else:
+            raise KeyError(f"unrecognized resnet key {key!r}")
+    return out
+
+
+def convert_torchvision_vgg16(state_dict: Dict) -> Dict[str, np.ndarray]:
+    """torchvision vgg16 state_dict → backbone-manifest flat dict
+    (13 convs + fc6/fc7, with the fc6 flatten-order permute)."""
+    names = []
+    for b, (n_convs, _w) in enumerate(_VGG16_PLAN, start=1):
+        names += [f"conv{b}_{c}" for c in range(1, n_convs + 1)]
+    idx_to_name = dict(zip(_VGG16_CONV_IDX, names))
+
+    out: Dict[str, np.ndarray] = {}
+    for key, val in state_dict.items():
+        parts = key.split(".")
+        if parts[0] == "features":
+            name = idx_to_name.get(int(parts[1]))
+            if name is None:
+                raise KeyError(f"unrecognized vgg16 conv index in {key!r}")
+            out[f"{name}/kernel" if parts[2] == "weight"
+                else f"{name}/bias"] = (
+                _conv(val) if parts[2] == "weight" else _np(val))
+        elif parts[0] == "classifier":
+            idx, leaf = int(parts[1]), parts[2]
+            if idx == 6:
+                continue  # ImageNet 1000-way classifier
+            name = {0: "fc6", 3: "fc7"}[idx]
+            if leaf == "bias":
+                out[f"{name}/bias"] = _np(val)
+            elif name == "fc6":
+                # (4096, 25088) over (C,H,W) flatten → (25088, 4096) over
+                # (H,W,C) flatten.
+                w = _np(val).reshape(4096, 512, 7, 7)
+                out["fc6/kernel"] = (
+                    w.transpose(2, 3, 1, 0).reshape(7 * 7 * 512, 4096))
+            else:
+                out[f"{name}/kernel"] = _np(val).T
+        else:
+            raise KeyError(f"unrecognized vgg16 key {key!r}")
+    return out
+
+
+CONVERTERS = {
+    "resnet50": convert_torchvision_resnet,
+    "resnet101": convert_torchvision_resnet,
+    "vgg16": convert_torchvision_vgg16,
+    "vgg": convert_torchvision_vgg16,
+}
+
+
+def convert(arch: str, state_dict: Dict, out_npz: str) -> Dict[str, np.ndarray]:
+    flat = CONVERTERS[arch](state_dict)
+    save_params_npz(out_npz, flat)
+    return flat
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("arch", choices=sorted(CONVERTERS))
+    p.add_argument("pth", help="torch state_dict file (.pth)")
+    p.add_argument("out", help="output .npz manifest path")
+    args = p.parse_args(argv)
+
+    import torch
+
+    sd = torch.load(args.pth, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    flat = convert(args.arch, sd, args.out)
+    print(f"wrote {args.out}: {len(flat)} arrays")
+
+
+if __name__ == "__main__":
+    main()

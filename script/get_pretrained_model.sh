@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Fetch ImageNet-pretrained backbones and convert them to the npz manifest
+# consumed by --pretrained (reference: script/get_pretrained_model.sh, which
+# downloaded MXNet .params files; here the public torchvision checkpoints
+# are the source — utils/torch_convert.py does the layout conversion).
+#
+# Requires network access (this CI container is offline: the script is the
+# pinned recipe for a connected machine).
+set -euo pipefail
+
+mkdir -p model
+declare -A URLS=(
+  [resnet50]=https://download.pytorch.org/models/resnet50-0676ba61.pth
+  [resnet101]=https://download.pytorch.org/models/resnet101-63fe2227.pth
+  [vgg16]=https://download.pytorch.org/models/vgg16-397923af.pth
+)
+
+for arch in resnet50 resnet101 vgg16; do
+  pth="model/${arch}-imagenet.pth"
+  [ -f "$pth" ] || curl -L -o "$pth" "${URLS[$arch]}"
+  python -m mx_rcnn_tpu.utils.torch_convert "$arch" "$pth" "model/${arch}.npz"
+done
+echo "manifests ready: model/{resnet50,resnet101,vgg16}.npz"

@@ -9,7 +9,7 @@ python train_end2end.py \
   --network resnet50 --dataset synthetic --from-scratch \
   --prefix model/synthetic_smoke --end_epoch 2 --frequent 5 --tpu-mesh "${TPU_MESH:-1}" "$@"
 
-python test.py \
+python test.py --batch_size 4 \
   --network resnet50 --dataset synthetic --from-scratch \
   --prefix model/synthetic_smoke --epoch 2
 

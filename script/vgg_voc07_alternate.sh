@@ -9,6 +9,6 @@ python train_alternate.py \
   --prefix model/vgg_voc07_alt --rpn_epoch 8 --rcnn_epoch 8 \
   --tpu-mesh "${TPU_MESH:-1}" "$@"
 
-python test.py \
+python test.py --batch_size 4 \
   --network vgg --dataset PascalVOC --image_set 2007_test \
   --prefix model/vgg_voc07_alt --epoch 8

@@ -11,7 +11,7 @@ python train_end2end.py \
   --prefix model/vitdet_b_coco --end_epoch 8 --lr 0.0001 --lr_step 6 \
   --tpu-mesh "${TPU_MESH:-8}" "$@"
 
-python test.py \
+python test.py --batch_size 4 \
   --network vitdet_b --dataset coco --image_set val2017 \
   --prefix model/vitdet_b_coco --epoch 8 \
   --out_json results/vitdet_b_coco_dets.json

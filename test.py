@@ -11,7 +11,7 @@ import argparse
 import jax
 
 from mx_rcnn_tpu.config import generate_config
-from mx_rcnn_tpu.data.datasets import get_dataset
+from mx_rcnn_tpu.data.datasets import dataset_from_config
 from mx_rcnn_tpu.data.loader import TestLoader
 from mx_rcnn_tpu.evaluation.tester import Predictor, pred_eval
 from mx_rcnn_tpu.logger import logger
@@ -52,8 +52,7 @@ def main():
     cfg = generate_config(args.network, args.dataset, **overrides)
     image_set = args.image_set or cfg.dataset.test_image_set
 
-    ds = get_dataset(cfg.dataset.name, image_set, cfg.dataset.root_path,
-                     cfg.dataset.dataset_path)
+    ds = dataset_from_config(cfg.dataset, image_set)
     roidb = ds.gt_roidb()
     model = build_model(cfg)
     template = init_params(model, cfg, jax.random.PRNGKey(0))

@@ -64,6 +64,45 @@ def test_forward_train_matches_all_gt(rng):
     assert float(aux["num_fg"]) == 2.0
 
 
+def test_aux_decoder_losses(rng):
+    """Carion et al. §3.2 auxiliary decoding losses: per-layer matched set
+    losses through SHARED heads — no extra params, every decoder layer
+    supervised, total = sum over layers."""
+    from dataclasses import replace
+
+    cfg = tiny_cfg()  # detr_aux_loss defaults True
+    cfg_no = cfg.with_updates(train=replace(cfg.train, detr_aux_loss=False))
+    model = zoo.build_model(cfg)
+    params = zoo.init_params(model, cfg, jax.random.PRNGKey(0))
+    batch = tiny_batch(rng)
+
+    loss_aux, m_aux = jax.jit(
+        lambda p, b, r: zoo.forward_train(model, p, b, r, cfg)
+    )(params, batch, jax.random.PRNGKey(1))
+    loss_no, m_no = jax.jit(
+        lambda p, b, r: zoo.forward_train(model, p, b, r, cfg_no)
+    )(params, batch, jax.random.PRNGKey(1))
+
+    # L=2 supervised layers: aux total strictly exceeds final-layer-only.
+    assert float(loss_aux) > float(loss_no)
+    # Metric slots report the final layer → identical across modes.
+    np.testing.assert_allclose(float(m_aux["rcnn_cls_loss"]),
+                               float(m_no["rcnn_cls_loss"]), rtol=1e-5)
+
+    # Shared heads: aux mode invents no parameters (same tree, and dec0
+    # now receives direct supervision -> nonzero grads).
+    grads = jax.jit(jax.grad(
+        lambda p: zoo.forward_train(model, p, batch,
+                                    jax.random.PRNGKey(1), cfg)[0]))(params)
+    assert jax.tree_util.tree_structure(grads) == \
+        jax.tree_util.tree_structure(params)
+    # (self_attn q/k at dec0 get structurally zero grads — the decoder
+    # input is zeros, so layer-0 value vectors are identical; cross-attn
+    # is where layer-0 supervision lands.)
+    g0 = grads["params"]["dec0"]["cross_attn"]["q"]["kernel"]
+    assert float(jnp.abs(g0).max()) > 0.0
+
+
 def test_forward_test_contract(rng):
     cfg = tiny_cfg()
     model = zoo.build_model(cfg)

@@ -59,7 +59,13 @@ def _dataset():
 
 @pytest.mark.slow
 def test_end2end_overfit_and_eval(tmp_path):
-    """FPN detector overfits 8 synthetic images and finds the objects."""
+    """FPN detector overfits 8 synthetic images and finds the objects.
+
+    Epoch budget + bar re-probed after the round-3 optimizer freeze fix
+    (optax.masked was applying raw-gradient ascent to the 'frozen' stem in
+    this from-scratch profile): mAP reaches 0.94 at epoch 5 and 1.0 from
+    epoch 6 on (scratch probe, seed 0) — 8 epochs with a 0.5 bar leaves
+    noise margin and is 43% shorter than round 2's 14-epoch gate."""
     cfg = generate_config("resnet50_fpn", "synthetic", **TINY)
     ds = _dataset()
     roidb = ds.gt_roidb()
@@ -70,31 +76,34 @@ def test_end2end_overfit_and_eval(tmp_path):
         history.append(bag.get()["TotalLoss"])
 
     params = fit_detector(
-        cfg, roidb, prefix=str(tmp_path / "ckpt"), end_epoch=14,
+        cfg, roidb, prefix=str(tmp_path / "ckpt"), end_epoch=8,
         frequent=1000, epoch_callback=record, seed=0)
 
-    assert len(history) == 14
+    assert len(history) == 8
     assert np.isfinite(history).all(), history
     assert history[-1] < history[0], history
 
     # Checkpoint round-trip happened (orbax wrote epoch dirs).
-    assert (tmp_path / "ckpt" / "0014").exists()
+    assert (tmp_path / "ckpt" / "0008").exists()
 
-    # Eval the trained params on the train images: the detector must find
-    # the rectangles (probed value ~0.7 mAP; the bar leaves slack for
-    # numeric drift, not for a broken pipeline).
     model = zoo.build_model(cfg)
     predictor = Predictor(model, params, cfg)
     loader = TestLoader(roidb, cfg, batch_size=1)
     result = pred_eval(predictor, loader, ds, thresh=0.05)
-    assert result["mAP"] > 0.25, result
+    assert result["mAP"] > 0.5, result
 
 
 @pytest.mark.slow
 def test_end2end_c4_smoke(tmp_path):
     """The classic C4 model through the same full loop: loader → fitted
-    epochs → checkpoint → Predictor → pred_eval (protocol runs; no mAP bar
-    — the C4 head needs more than a CI budget to converge from scratch)."""
+    epochs → checkpoint → Predictor → pred_eval.
+
+    Gate calibration (scratch probes, seed 0): at this budget TotalLoss is
+    dominated by the noisy RCNN-head sampling losses (probed epochs:
+    2.09, 1.38, 1.87, 2.34 — no usable ratio), but RPNLogLoss decreases
+    monotonically (0.286 → 0.223 → 0.165 → 0.143). The gate therefore
+    requires the RPN to actually LEARN (30% log-loss drop; probed drop is
+    50%) plus no blow-up of the total — a non-learning model fails."""
     cfg = generate_config("resnet50", "synthetic",
                           **dict(TINY, **{"train.lr": 0.002}))
     ds = _dataset()
@@ -102,14 +111,17 @@ def test_end2end_c4_smoke(tmp_path):
     history = []
 
     def record(epoch, state, bag):
-        history.append(bag.get()["TotalLoss"])
+        history.append(dict(bag.get()))
 
     params = fit_detector(cfg, roidb, prefix=str(tmp_path / "ckpt"),
                           end_epoch=3, frequent=1000, epoch_callback=record,
                           seed=0)
     assert len(history) == 3
-    assert np.isfinite(history).all(), history
-    assert history[-1] < history[0] * 2, history  # no blow-up
+    total = [h["TotalLoss"] for h in history]
+    rpn_log = [h["RPNLogLoss"] for h in history]
+    assert np.isfinite(total).all(), total
+    assert total[-1] < total[0] * 2, total  # no blow-up
+    assert rpn_log[-1] < rpn_log[0] * 0.7, rpn_log  # the RPN learned
     assert (tmp_path / "ckpt" / "0003").exists()
 
     model = zoo.build_model(cfg)

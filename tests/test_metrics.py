@@ -7,7 +7,7 @@ analog. These tests pin the family-aware slot reporting added for DETR.
 
 import numpy as np
 
-from mx_rcnn_tpu.train.metrics import METRIC_NAMES, MetricBag
+from mx_rcnn_tpu.train.metrics import MetricBag
 
 
 def test_running_means():
@@ -29,13 +29,12 @@ def test_unseen_slots_are_omitted():
     assert "RPNAcc" not in got and "RCNNAcc" not in got
 
 
-def test_empty_bag_returns_zero_filled():
-    """No updates at all (empty epoch): fixed-key consumers still find
-    every named slot, at 0.0 — never a KeyError."""
+def test_empty_bag_returns_empty_dict():
+    """No updates at all (empty epoch): unseen slots are omitted — the
+    SAME rule as mid-training, so a fixed-key consumer that works on an
+    empty epoch cannot start KeyError-ing once updates arrive."""
     bag = MetricBag()
-    got = bag.get()
-    assert set(got) == set(METRIC_NAMES)
-    assert all(v == 0.0 for v in got.values())
+    assert bag.get() == {}
 
 
 def test_intermittent_slot_uses_per_slot_count():
@@ -55,7 +54,7 @@ def test_reset_clears_seen_and_sums():
     bag.update({"TotalLoss": 2.0})
     bag.get()
     bag.reset()
-    assert bag.get()["TotalLoss"] == 0.0  # back to the empty-bag shape
+    assert bag.get() == {}  # back to the empty-bag shape
     bag.update({"RPNLogLoss": 1.0})
     assert set(bag.get()) == {"RPNLogLoss"}
 

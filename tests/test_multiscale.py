@@ -1,0 +1,177 @@
+"""Multi-scale training via static scale buckets (data/loader.py,
+config.ImageConfig.pad_shapes).
+
+Reference: config.TRAIN.SCALES multi-entry support in the classic lineage
+(BASELINE config 3 "multi-scale"). TPU delta (documented in config.py): the
+scale is sampled PER BATCH, each scale has its own static pad bucket, and
+each bucket costs one extra jit compile of the train step.
+"""
+
+import numpy as np
+
+import jax
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
+from mx_rcnn_tpu.data.loader import AnchorLoader, TestLoader, pad_shape_for
+from mx_rcnn_tpu.models import zoo
+
+TWO_SCALE = {
+    "image.scales": ((96, 160), (128, 160)),
+    "image.pad_shapes": ((96, 96), (128, 128)),
+    "image.pad_shape": (128, 128),
+    "network.norm": "group",
+    "network.freeze_at": 0,
+    "network.anchor_scales": (2, 4, 8),
+    "train.rpn_pre_nms_top_n": 128,
+    "train.rpn_post_nms_top_n": 32,
+    "train.batch_rois": 16,
+    "train.max_gt_boxes": 8,
+    "train.batch_images": 1,
+    "train.flip": False,
+    "train.fpn_rpn_pre_nms_per_level": 64,
+    "test.fpn_rpn_pre_nms_per_level": 32,
+    "test.rpn_pre_nms_top_n": 64,
+    "test.rpn_post_nms_top_n": 16,
+}
+
+
+def _roidb(n=12):
+    ds = SyntheticDataset("train", num_images=n, image_size=128,
+                          max_objects=2, min_size_frac=4, max_size_frac=2)
+    return ds.gt_roidb()
+
+
+def test_pad_shape_for_fallback_rule():
+    """pad_shapes is honored only when it matches scales entry-for-entry —
+    overriding scales alone must not pair with stale buckets."""
+    cfg = generate_config("resnet50_fpn", "synthetic",
+                          **{"image.scales": ((128, 128),),
+                             "image.pad_shape": (128, 128)})
+    # preset pad_shapes (2 entries) vs overridden scales (1) → fallback
+    assert pad_shape_for(cfg, 0) == (128, 128)
+    cfg2 = generate_config("resnet50_fpn", "synthetic", **TWO_SCALE)
+    assert pad_shape_for(cfg2, 0) == (96, 96)
+    assert pad_shape_for(cfg2, 1) == (128, 128)
+
+
+def test_override_consistency_drops_preset_buckets():
+    """generate_config: overriding pad_shape (or scales) without
+    pad_shapes drops the preset buckets, so the override actually takes
+    effect — even when the overridden scales count still matches the
+    preset bucket count."""
+    cfg = generate_config("resnet101_fpn", "coco",
+                          **{"image.pad_shape": (640, 1024)})
+    assert cfg.image.pad_shapes == ()
+    assert pad_shape_for(cfg, 0) == (640, 1024)  # not the 1088 bucket
+    # same-length scales override: stale buckets must not survive either
+    cfg2 = generate_config("resnet101_fpn", "coco",
+                           **{"image.scales": ((1000, 1666), (1200, 2000))})
+    assert cfg2.image.pad_shapes == ()
+    # explicit pad_shapes override still wins
+    cfg3 = generate_config("resnet101_fpn", "coco",
+                           **{"image.scales": ((96, 160), (128, 160)),
+                              "image.pad_shapes": ((96, 96), (128, 128))})
+    assert pad_shape_for(cfg3, 0) == (96, 96)
+
+
+def test_fpn_presets_carry_multiscale_recipe():
+    cfg = generate_config("resnet101_fpn", "coco")
+    assert len(cfg.image.scales) == 2
+    assert len(cfg.image.pad_shapes) == len(cfg.image.scales)
+    assert cfg.image.scales[-1] == (800, 1333)  # test-time scale
+    for (h, w) in cfg.image.pad_shapes:
+        assert h % 32 == 0 and w % 32 == 0  # exact FPN top-down shapes
+
+
+def test_loader_emits_both_buckets():
+    cfg = generate_config("resnet50_fpn", "synthetic", **TWO_SCALE)
+    loader = AnchorLoader(_roidb(), cfg, num_shards=1, seed=0)
+    shapes = set()
+    for _ in range(3):  # 3 epochs × 12 batches: both buckets certain
+        for batch in loader:
+            shapes.add(batch["image"].shape[1:3])
+            h, w = batch["im_info"][0, :2]
+            assert h <= batch["image"].shape[1]
+            assert w <= batch["image"].shape[2]
+            # gt boxes live inside the scaled image region
+            v = batch["gt_valid"][0]
+            if v.any():
+                assert batch["gt_boxes"][0][v][:, 2].max() <= w
+                assert batch["gt_boxes"][0][v][:, 3].max() <= h
+    assert shapes == {(96, 96), (128, 128)}, shapes
+
+
+def test_train_step_executes_on_both_buckets():
+    """The jitted step retraces per bucket and runs on each (BASELINE
+    config 3 'multi-scale' — the FPN recipe trains at ≥2 scales)."""
+    from mx_rcnn_tpu.train.optimizer import build_optimizer
+    from mx_rcnn_tpu.train.step import create_train_state, make_train_step
+
+    cfg = generate_config("resnet50_fpn", "synthetic", **TWO_SCALE)
+    model = zoo.build_model(cfg)
+    params = zoo.init_params(model, cfg, jax.random.PRNGKey(0))
+    tx = build_optimizer(cfg, params, steps_per_epoch=10)
+    state = create_train_state(params, tx)
+    step_fn = make_train_step(model, cfg, mesh=None, donate=False,
+                              forward_fn=zoo.forward_train)
+
+    loader = AnchorLoader(_roidb(), cfg, num_shards=1, seed=0)
+    seen = set()
+    for batch in loader:
+        shape = batch["image"].shape[1:3]
+        if shape in seen:
+            continue
+        seen.add(shape)
+        state, metrics = step_fn(state, batch, jax.random.PRNGKey(1))
+        assert np.isfinite(float(metrics["TotalLoss"])), shape
+        if len(seen) == 2:
+            break
+    assert len(seen) == 2, "epoch did not produce both scale buckets"
+
+
+def test_orientation_aware_buckets():
+    """Buckets are stored landscape-oriented and transposed for portrait
+    batches; only a mixed batch pays the square cover (the r03 review's
+    ~60%-wasted-FLOPs finding on square-only covers)."""
+    from mx_rcnn_tpu.data.loader import resolve_pad_bucket
+
+    cfg = generate_config("resnet101_fpn", "coco")
+    assert resolve_pad_bucket(cfg, 1, [True, True]) == (832, 1344)
+    assert resolve_pad_bucket(cfg, 1, [False, False]) == (1344, 832)
+    assert resolve_pad_bucket(cfg, 1, [True, False]) == (1344, 1344)
+    assert resolve_pad_bucket(cfg, 0, [True]) == (672, 1088)
+
+
+def test_portrait_batch_is_transpose_padded():
+    """A portrait image trains in the transposed bucket, not a square."""
+    cfg = generate_config("resnet50_fpn", "synthetic", **dict(
+        TWO_SCALE, **{"image.scales": ((96, 160),),
+                      "image.pad_shapes": ((96, 160),),
+                      "image.pad_shape": (160, 160)}))
+    ds = SyntheticDataset("train", num_images=4, image_size=128,
+                          max_objects=2, min_size_frac=4, max_size_frac=2)
+    roidb = []
+    for entry in ds.gt_roidb():
+        e = dict(entry)
+        # crop to a portrait 128x64 canvas: transpose image_data + boxes
+        e["image_data"] = entry["image_data"][:, :64]
+        e["boxes"] = np.clip(entry["boxes"], 0, [63, 127, 63, 127]).astype(
+            entry["boxes"].dtype)
+        e["width"], e["height"] = 64, 128
+        roidb.append(e)
+    loader = AnchorLoader(roidb, cfg, num_shards=1, seed=0)
+    batch = next(iter(loader))
+    # portrait 128x64: scale = min(96/64, 160/128) = 1.25 -> 160x80,
+    # padded into the TRANSPOSED (160, 96) bucket, not a 160x160 square
+    assert batch["image"].shape[1:3] == (160, 96)
+
+
+def test_testloader_uses_largest_scale():
+    cfg = generate_config("resnet50_fpn", "synthetic", **TWO_SCALE)
+    loader = TestLoader(_roidb(4), cfg, batch_size=1)
+    batch, metas = next(iter(loader))
+    # largest scale (128,160) on a 128px square image → scale 1.0,
+    # padded to the (128,128) bucket
+    assert batch["image"].shape[1:3] == (128, 128)
+    assert metas[0]["scale"] == 1.0

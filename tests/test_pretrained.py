@@ -1,0 +1,361 @@
+"""Pretrained-weight import path (utils/pretrained.py, utils/torch_convert.py).
+
+Reference: rcnn/utils/load_model.py::load_param over ImageNet .params +
+script/get_pretrained_model.sh (SURVEY.md §3). Offline, so the torch-side
+inputs are SYNTHETIC state_dicts built with torchvision's exact naming and
+shapes; the import side validates every array against the real flax param
+tree, so a wrong transpose, name map, or routing rule fails here.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models import zoo
+from mx_rcnn_tpu.utils.pretrained import (
+    flatten_params,
+    import_pretrained,
+    load_params_npz,
+    save_params_npz,
+    unflatten_params,
+)
+from mx_rcnn_tpu.utils.torch_convert import (
+    convert,
+    convert_torchvision_resnet,
+    convert_torchvision_vgg16,
+)
+
+RESNET_BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3)}
+
+
+def _he(rs, *shape):
+    """Conv weight at torchvision scale — He init over fan_in (O,I,kH,kW).
+    Realistic magnitudes matter: the readiness drill trains through these
+    with frozen BN, where unit-std weights explode in a 100-layer trunk."""
+    fan_in = int(np.prod(shape[1:]))
+    return (rs.randn(*shape) * np.sqrt(2.0 / fan_in)).astype(np.float32)
+
+
+def fake_torch_resnet(depth: int, rs: np.random.RandomState):
+    """state_dict with torchvision resnet naming/shapes (numpy values)."""
+    sd = {}
+
+    def bn(prefix, ch):
+        sd[f"{prefix}.weight"] = (1 + 0.1 * rs.randn(ch)).astype(np.float32)
+        sd[f"{prefix}.bias"] = (0.1 * rs.randn(ch)).astype(np.float32)
+        sd[f"{prefix}.running_mean"] = (0.1 * rs.randn(ch)).astype(np.float32)
+        sd[f"{prefix}.running_var"] = (1 + 0.1 * rs.rand(ch)).astype(np.float32)
+        sd[f"{prefix}.num_batches_tracked"] = np.asarray(1)
+
+    sd["conv1.weight"] = _he(rs, 64, 3, 7, 7)
+    bn("bn1", 64)
+    in_ch = 64
+    for s, (blocks, width) in enumerate(
+            zip(RESNET_BLOCKS[depth], (64, 128, 256, 512)), start=1):
+        for b in range(blocks):
+            p = f"layer{s}.{b}"
+            sd[f"{p}.conv1.weight"] = _he(rs, width, in_ch, 1, 1)
+            bn(f"{p}.bn1", width)
+            sd[f"{p}.conv2.weight"] = _he(rs, width, width, 3, 3)
+            bn(f"{p}.bn2", width)
+            sd[f"{p}.conv3.weight"] = _he(rs, width * 4, width, 1, 1)
+            bn(f"{p}.bn3", width * 4)
+            if b == 0:
+                sd[f"{p}.downsample.0.weight"] = _he(rs, width * 4, in_ch, 1, 1)
+                bn(f"{p}.downsample.1", width * 4)
+            in_ch = width * 4
+    sd["fc.weight"] = (0.01 * rs.randn(1000, 2048)).astype(np.float32)
+    sd["fc.bias"] = np.zeros(1000, np.float32)
+    return sd
+
+
+VGG16_TORCH_CONV_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+VGG16_WIDTHS = (64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512)
+
+
+def fake_torch_vgg16(rs: np.random.RandomState):
+    sd = {}
+    in_ch = 3
+    for idx, width in zip(VGG16_TORCH_CONV_IDX, VGG16_WIDTHS):
+        sd[f"features.{idx}.weight"] = _he(rs, width, in_ch, 3, 3)
+        sd[f"features.{idx}.bias"] = (0.1 * rs.randn(width)).astype(np.float32)
+        in_ch = width
+    sd["classifier.0.weight"] = (0.01 * rs.randn(4096, 512 * 7 * 7)).astype(np.float32)
+    sd["classifier.0.bias"] = (0.1 * rs.randn(4096)).astype(np.float32)
+    sd["classifier.3.weight"] = (0.01 * rs.randn(4096, 4096)).astype(np.float32)
+    sd["classifier.3.bias"] = (0.1 * rs.randn(4096)).astype(np.float32)
+    sd["classifier.6.weight"] = (0.01 * rs.randn(1000, 4096)).astype(np.float32)
+    sd["classifier.6.bias"] = np.zeros(1000, np.float32)
+    return sd
+
+
+def tiny_template(network: str):
+    """Returns (cfg, bare param dict) — init_params wraps in {'params': …};
+    import_pretrained accepts both forms (the wrapped form is what
+    fit_detector passes; test_wrapped_template_form covers it)."""
+    cfg = generate_config(network, "synthetic",
+                          **{"image.pad_shape": (128, 128),
+                             "train.batch_images": 1})
+    model = zoo.build_model(cfg)
+    return cfg, zoo.init_params(model, cfg, jax.random.PRNGKey(0))["params"]
+
+
+def test_flatten_unflatten_roundtrip(rng):
+    tree = {"a": {"b": rng.randn(2, 3), "c": {"d": rng.randn(4)}},
+            "e": rng.randn(1)}
+    flat = flatten_params(tree)
+    assert set(flat) == {"a/b", "a/c/d", "e"}
+    back = unflatten_params(flat)
+    np.testing.assert_array_equal(back["a"]["c"]["d"], tree["a"]["c"]["d"])
+
+
+def test_npz_roundtrip(tmp_path, rng):
+    tree = {"x": {"kernel": rng.randn(3, 3).astype(np.float32)}}
+    path = str(tmp_path / "w.npz")
+    save_params_npz(path, tree)
+    flat = load_params_npz(path)
+    np.testing.assert_array_equal(flat["x/kernel"], tree["x"]["kernel"])
+
+
+def test_resnet50_import_c4(tmp_path, rng):
+    """Full torchvision-style resnet50 → C4 detector: every backbone leaf
+    (features/* AND the stage-4 head) is loaded; detection heads keep init."""
+    sd = fake_torch_resnet(50, rng)
+    path = str(tmp_path / "r50.npz")
+    convert("resnet50", sd, path)
+
+    _, params = tiny_template("resnet50")
+    before = flatten_params(params)
+    loaded, report = import_pretrained(path, params)
+    after = flatten_params(loaded)
+
+    feat_keys = [k for k in after if k.startswith("features/")]
+    head_keys = [k for k in after if k.startswith("head/stage4/")]
+    assert feat_keys and head_keys
+    for k in feat_keys + head_keys:
+        assert not np.array_equal(after[k], before[k]), f"{k} not loaded"
+    for k in after:
+        if k.startswith(("rpn/", "cls_score", "bbox_pred")):
+            np.testing.assert_array_equal(after[k], before[k])
+    # the ImageNet fc classifier must have been dropped at convert time
+    assert not any("fc." in u or u.startswith("fc/") for u in report.unused)
+    assert not report.uninitialized or all(
+        k.startswith(("rpn/", "cls_score", "bbox_pred"))
+        for k in report.uninitialized)
+
+
+def test_resnet50_import_fpn_routes_stage4_to_features(tmp_path, rng):
+    sd = fake_torch_resnet(50, rng)
+    path = str(tmp_path / "r50.npz")
+    convert("resnet50", sd, path)
+    _, params = tiny_template("resnet50_fpn")
+    loaded, report = import_pretrained(path, params)
+    assert any(k.startswith("features/stage4/") for k in report.loaded)
+    # FPN neck + heads keep their init, trunk fully covered
+    assert not any(k.startswith("features/") for k in report.uninitialized)
+
+
+def test_resnet101_conversion_covers_template(tmp_path, rng):
+    sd = fake_torch_resnet(101, rng)
+    path = str(tmp_path / "r101.npz")
+    convert("resnet101", sd, path)
+    _, params = tiny_template("resnet101")
+    loaded, report = import_pretrained(path, params)
+    assert not any(k.startswith(("features/", "head/"))
+                   for k in report.uninitialized)
+
+
+def test_vgg16_import(tmp_path, rng):
+    sd = fake_torch_vgg16(rng)
+    path = str(tmp_path / "vgg16.npz")
+    convert("vgg16", sd, path)
+    _, params = tiny_template("vgg")
+    before = flatten_params(params)
+    loaded, report = import_pretrained(path, params)
+    after = flatten_params(loaded)
+    for k in after:
+        if k.startswith("features/") or k.startswith("head/fc"):
+            assert not np.array_equal(after[k], before[k]), f"{k} not loaded"
+    assert not report.unused  # every converted array found a home
+
+
+def test_vgg_fc6_flatten_order_permute(rng):
+    """fc6 applied to our (H,W,C)-flattened pool must equal torch's linear
+    on the same features flattened (C,H,W) — the permute is load-bearing."""
+    sd = fake_torch_vgg16(rng)
+    flat = convert_torchvision_vgg16(sd)
+    feat_hwc = rng.randn(7, 7, 512).astype(np.float32)
+    ours = feat_hwc.reshape(-1) @ flat["fc6/kernel"] + flat["fc6/bias"]
+    torch_in = feat_hwc.transpose(2, 0, 1).reshape(-1)  # (C,H,W) flatten
+    theirs = sd["classifier.0.weight"] @ torch_in + sd["classifier.0.bias"]
+    # 25088-term float32 dots in two accumulation orders
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-2)
+
+
+def test_resnet_conv_transpose_is_functional(rng):
+    """HWIO conversion: jax conv with converted kernel == torch-layout
+    reference conv (spot check on the stem 7x7)."""
+    sd = fake_torch_resnet(50, rng)
+    flat = convert_torchvision_resnet(sd)
+    x = rng.randn(1, 16, 16, 3).astype(np.float32)
+    y = jax.lax.conv_general_dilated(
+        x, flat["conv0/kernel"], window_strides=(2, 2),
+        padding=[(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # reference: NCHW conv with the original OIHW kernel
+    y_ref = jax.lax.conv_general_dilated(
+        x.transpose(0, 3, 1, 2), sd["conv1.weight"], window_strides=(2, 2),
+        padding=[(3, 3), (3, 3)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(y_ref).transpose(0, 2, 3, 1),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_strict_backbone_rejects_partial_manifest(tmp_path, rng):
+    sd = fake_torch_resnet(50, rng)
+    flat = convert_torchvision_resnet(sd)
+    partial = {k: v for k, v in flat.items() if not k.startswith("stage3")}
+    path = str(tmp_path / "partial.npz")
+    save_params_npz(path, partial)
+    _, params = tiny_template("resnet50")
+    with pytest.raises(ValueError, match="backbone leaves not covered"):
+        import_pretrained(path, params)
+    loaded, report = import_pretrained(path, params, strict_backbone=False)
+    assert any(k.startswith("features/stage3") for k in report.uninitialized)
+
+
+def test_strict_covers_c4_head_stage4(tmp_path, rng):
+    """A manifest missing stage4 must FAIL against a C4 model even though
+    every features/ leaf loads — stage4 is trunk there, routed to head/
+    (the classic silently-half-loaded-trunk trap)."""
+    sd = fake_torch_resnet(50, rng)
+    flat = convert_torchvision_resnet(sd)
+    no_s4 = {k: v for k, v in flat.items() if not k.startswith("stage4")}
+    path = str(tmp_path / "no_s4.npz")
+    save_params_npz(path, no_s4)
+    _, params = tiny_template("resnet50")
+    with pytest.raises(ValueError, match="backbone leaves not covered"):
+        import_pretrained(path, params)
+    # ...but the same truncated manifest is fine for FPN (stage4 under
+    # features/ would be missing there too — also caught):
+    _, fpn_params = tiny_template("resnet50_fpn")
+    with pytest.raises(ValueError, match="backbone leaves not covered"):
+        import_pretrained(path, fpn_params)
+
+
+def test_backbone_shape_mismatch_raises(tmp_path, rng):
+    """A resnet50 manifest against a resnet101 model must fail loudly
+    (stage3 block counts differ → first shape clash raises)."""
+    sd = fake_torch_resnet(50, rng)
+    path = str(tmp_path / "r50.npz")
+    convert("resnet50", sd, path)
+    _, params = tiny_template("resnet101")
+    with pytest.raises(ValueError):
+        import_pretrained(path, params)
+
+
+def test_class_count_mismatch_heads_keep_init(tmp_path, rng):
+    """Full-tree npz from an N-class model into an M-class model: heads
+    skip (reference load_param behavior), trunk loads."""
+    _, params = tiny_template("resnet50")
+    path = str(tmp_path / "full.npz")
+    # Perturb so "loaded" is detectable, then grow cls_score out dim.
+    full = flatten_params(params)
+    full = {k: v + 1.0 for k, v in full.items()}
+    full["cls_score/kernel"] = rng.randn(2048, 99).astype(np.float32)
+    full["cls_score/bias"] = rng.randn(99).astype(np.float32)
+    save_params_npz(path, full)
+    loaded, report = import_pretrained(path, params)
+    assert len(report.skipped) == 2
+    after = flatten_params(loaded)
+    np.testing.assert_array_equal(after["cls_score/bias"],
+                                  flatten_params(params)["cls_score/bias"])
+    assert any(k.startswith("features/") for k in report.loaded)
+
+
+def test_garbage_npz_rejected(tmp_path, rng):
+    path = str(tmp_path / "junk.npz")
+    save_params_npz(path, {"not/a/real/key": rng.randn(3)})
+    _, params = tiny_template("resnet50")
+    with pytest.raises(ValueError, match="no key"):
+        import_pretrained(path, params, strict_backbone=False)
+
+
+@pytest.mark.slow
+def test_readiness_drill_r101(tmp_path, rng):
+    """Launch-readiness drill for the flagship R101 recipe: convert a
+    (synthetic) torchvision-style ImageNet checkpoint → train 1 epoch from
+    it with the PRETRAINED profile (frozen-BN + frozen prefix — only sound
+    with imported statistics) → eval through the test.py path. This is the
+    exact sequence the real COCO run will execute when data appears; only
+    the weights and images are synthetic."""
+    from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.data.loader import TestLoader
+    from mx_rcnn_tpu.evaluation.tester import Predictor, pred_eval
+    from mx_rcnn_tpu.tools.train import fit_detector
+
+    npz = str(tmp_path / "r101_imagenet.npz")
+    convert("resnet101", fake_torch_resnet(101, rng), npz)
+
+    # The flagship config at drill shapes. norm/freeze_at stay at the
+    # pretrained-profile defaults (frozen_bn, freeze_at=2).
+    cfg = generate_config("resnet101", "synthetic", **{
+        "image.pad_shape": (128, 128),
+        "image.scales": ((128, 128),),
+        "network.anchor_scales": (2, 4, 8),
+        "train.rpn_pre_nms_top_n": 256,
+        "train.rpn_post_nms_top_n": 64,
+        "train.batch_rois": 32,
+        "train.max_gt_boxes": 8,
+        "train.batch_images": 1,
+        "train.flip": False,
+        "test.rpn_pre_nms_top_n": 128,
+        "test.rpn_post_nms_top_n": 32,
+        "test.max_per_image": 8,
+    })
+    ds = SyntheticDataset("train", num_images=4, image_size=128,
+                          max_objects=2, min_size_frac=4, max_size_frac=2)
+    roidb = ds.gt_roidb()
+
+    history = []
+    params = fit_detector(
+        cfg, roidb, prefix=str(tmp_path / "ckpt"), end_epoch=1, frequent=1000,
+        epoch_callback=lambda e, s, b: history.append(b.get()["TotalLoss"]),
+        pretrained_npz=npz, seed=0)
+    assert np.isfinite(history).all(), history
+
+    # The frozen prefix (stem + stage1) must be bit-identical to the
+    # imported ImageNet weights after training — freezing is structural.
+    after = flatten_params(params["params"] if "params" in params else params)
+    manifest = load_params_npz(npz)
+    np.testing.assert_array_equal(np.asarray(after["features/conv0/kernel"]),
+                                  manifest["conv0/kernel"])
+    np.testing.assert_array_equal(
+        np.asarray(after["features/stage1/block0/conv1/kernel"]),
+        manifest["stage1/block0/conv1/kernel"])
+    # ...and stage3 must have trained away from the import.
+    assert not np.array_equal(
+        np.asarray(after["features/stage3/block0/conv1/kernel"]),
+        manifest["stage3/block0/conv1/kernel"])
+
+    model = zoo.build_model(cfg)
+    result = pred_eval(Predictor(model, params, cfg),
+                       TestLoader(roidb, cfg, batch_size=1), ds, thresh=0.05)
+    assert "mAP" in result and np.isfinite(result["mAP"])
+
+
+def test_wrapped_template_form(tmp_path, rng):
+    """fit_detector passes the {'params': …} wrapping; the import must
+    accept it and return the same wrapping."""
+    sd = fake_torch_resnet(50, rng)
+    path = str(tmp_path / "r50.npz")
+    convert("resnet50", sd, path)
+    _, bare = tiny_template("resnet50")
+    loaded, _ = import_pretrained(path, {"params": bare})
+    assert set(loaded) == {"params"}
+    np.testing.assert_array_equal(
+        flatten_params(loaded["params"])["features/conv0/kernel"],
+        convert_torchvision_resnet(sd)["conv0/kernel"])

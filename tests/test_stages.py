@@ -1,0 +1,188 @@
+"""Alternate-training stage drivers (tools/stages.py, train_alternate.py).
+
+Reference: train_alternate.py's 7-step Ren et al. pipeline chained from
+rcnn/tools/{train_rpn,test_rpn,train_rcnn,test_rcnn,reeval}.py (SURVEY.md
+§4.4) — stages communicate via files (checkpoints + proposal pickles).
+These tests execute the real file contracts at tiny shapes: the slow gate
+runs the WHOLE 7-step dance; the fast tests pin the proposal-pickle →
+rpn_roidb → ROIIter leg and reeval without training.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data.datasets import dataset_from_config
+from mx_rcnn_tpu.data.loader import ROIIter, TestLoader
+from mx_rcnn_tpu.evaluation.tester import Predictor, generate_proposals
+from mx_rcnn_tpu.models import zoo
+from mx_rcnn_tpu.tools import stages
+
+TINY = {
+    "dataset.kwargs": (("num_images", 6), ("image_size", 128),
+                       ("max_objects", 2), ("min_size_frac", 4),
+                       ("max_size_frac", 2)),
+    "image.pad_shape": (128, 128),
+    "image.scales": ((128, 128),),
+    "network.norm": "group",
+    "network.freeze_at": 0,
+    "network.anchor_scales": (2, 4, 8),
+    "train.rpn_positive_overlap": 0.5,
+    "train.rpn_pre_nms_top_n": 256,
+    "train.rpn_post_nms_top_n": 64,
+    "train.batch_rois": 32,
+    "train.max_gt_boxes": 8,
+    "train.batch_images": 1,
+    "train.flip": False,
+    "train.lr": 0.001,
+    "train.lr_step": (100,),
+    "test.rpn_pre_nms_top_n": 128,
+    "test.rpn_post_nms_top_n": 32,
+    "test.max_per_image": 8,
+}
+
+
+def tiny_cfg():
+    return generate_config("resnet50", "synthetic", **TINY)
+
+
+def test_generate_then_roiiter_contract(tmp_path):
+    """Stage 2→3 file contract without training: RPN proposal dump from a
+    fresh-init predictor → rpn_roidb merge → ROIIter batches carry scaled,
+    valid proposals (reference: test_rpn.py --gen → train_rcnn.py)."""
+    cfg = tiny_cfg()
+    params = zoo.init_params(zoo.build_model(cfg), cfg, jax.random.PRNGKey(0))
+
+    rpn_file = str(tmp_path / "props.pkl")
+    files = stages.test_rpn_generate(cfg, params, rpn_file)
+    assert files == [rpn_file]
+
+    with open(rpn_file, "rb") as f:
+        dumped = pickle.load(f)
+    ds = dataset_from_config(cfg.dataset)
+    assert len(dumped) == len(ds.gt_roidb())
+    for arr in dumped:
+        assert arr.ndim == 2 and arr.shape[1] == 5  # x1y1x2y2 + score
+        assert np.isfinite(arr).all()
+
+    roidb = stages._attach_proposals(cfg, rpn_file)
+    assert roidb and all("proposals" in r for r in roidb)
+
+    it = ROIIter(roidb, cfg, num_shards=1, max_proposals=64, seed=0)
+    batch = next(iter(it))
+    assert batch["proposals"].shape == (1, 64, 4)
+    assert batch["proposal_valid"].any()
+    # proposals are image-scale pixels inside the padded canvas
+    v = batch["proposals"][batch["proposal_valid"]]
+    assert (v[:, 2] >= v[:, 0]).all() and (v[:, 3] >= v[:, 1]).all()
+    assert v.max() <= max(cfg.image.pad_shape)
+
+
+def test_reeval_roundtrip(tmp_path):
+    """tools/reeval.py analog: saved all_boxes pickle → evaluate_detections
+    (reference: re-scoring saved detections without a model)."""
+    cfg = tiny_cfg()
+    ds = dataset_from_config(cfg.dataset, cfg.dataset.test_image_set)
+    roidb = ds.gt_roidb()
+    num_images = len(roidb)
+    # Perfect detections: each gt box at score 1 in its own class slot.
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(num_images)]
+                 for _ in range(ds.num_classes)]
+    for i, entry in enumerate(roidb):
+        for box, cls in zip(entry["boxes"], entry["gt_classes"]):
+            det = np.concatenate([box, [1.0]]).astype(np.float32)[None]
+            all_boxes[cls][i] = np.concatenate([all_boxes[cls][i], det])
+    pkl = str(tmp_path / "detections.pkl")
+    with open(pkl, "wb") as f:
+        pickle.dump(all_boxes, f)
+    result = stages.reeval(ds, pkl)
+    assert result["mAP"] > 0.99, result
+
+
+def test_selective_search_proposal_roidb_trains(tmp_path):
+    """Fast R-CNN over EXTERNAL proposals (reference: selective-search
+    pickles via rcnn/utils/load_data.py::load_proposal_roidb): a (n,4)/(n,5)
+    per-image pickle → load_proposal_roidb → ROIIter → one finite
+    forward_train_rcnn grad step, no RPN anywhere."""
+    from mx_rcnn_tpu.models.faster_rcnn import forward_train_rcnn
+
+    cfg = tiny_cfg()
+    ds = dataset_from_config(cfg.dataset)
+    gt = ds.gt_roidb()
+
+    rs = np.random.RandomState(0)
+    props = []
+    for i, entry in enumerate(gt):
+        jit = entry["boxes"].astype(np.float32) + rs.uniform(-4, 4, (len(entry["boxes"]), 4))
+        rand = rs.uniform(0, 100, (16, 4)).astype(np.float32)
+        rand[:, 2:] = rand[:, :2] + rs.uniform(8, 28, (16, 2))
+        arr = np.concatenate([jit, rand]).astype(np.float32)
+        if i % 2:  # exercise both accepted layouts
+            arr = np.concatenate([arr, rs.rand(len(arr), 1).astype(np.float32)], axis=1)
+        props.append(arr)
+    pkl = str(tmp_path / "ss_proposals.pkl")
+    with open(pkl, "wb") as f:
+        pickle.dump(props, f)
+
+    roidb = ds.load_proposal_roidb(gt, pkl)
+    assert all(r["proposals"].shape[1] == 4 for r in roidb)
+
+    it = ROIIter(roidb, cfg, num_shards=1, max_proposals=32, seed=0)
+    batch = next(iter(it))
+    assert batch["proposal_valid"].any()
+
+    model = zoo.build_model(cfg)
+    params = zoo.init_params(model, cfg, jax.random.PRNGKey(0))
+    batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
+    (loss, _), grads = jax.jit(jax.value_and_grad(
+        lambda p: forward_train_rcnn(model, p, batch_j,
+                                     jax.random.PRNGKey(1), cfg),
+        has_aux=True))(params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree_util.tree_leaves(grads))
+
+
+@pytest.mark.slow
+def test_alternate_training_full_pipeline(tmp_path):
+    """The 7-step dance end-to-end at tiny shapes: every stage driver in
+    tools/stages.py runs for real, files are the only coupling, and the
+    combined checkpoint evaluates through test_rcnn (tools/test_rcnn.py
+    analog). One epoch per stage: this gates plumbing, not convergence."""
+    from mx_rcnn_tpu.train.checkpoint import load_checkpoint
+    from train_alternate import alternate_train
+
+    cfg = tiny_cfg()
+    prefix = str(tmp_path / "alt")
+    final = alternate_train(cfg, prefix, rpn_epoch=1, rcnn_epoch=1,
+                            frequent=1000)
+
+    # stage artifacts all exist
+    import os
+    for f in ("_rpn1_proposals.pkl", "_rpn2_proposals.pkl"):
+        assert os.path.exists(prefix + f), f
+    for d in ("_rpn1", "_rcnn1", "_rpn2", "_rcnn2"):
+        assert os.path.isdir(prefix + d), d
+
+    # stage 4/6 froze the trunk: rpn2/rcnn2 share stage-3's features
+    t_rcnn1 = zoo.init_params(zoo.build_model(cfg), cfg,
+                              jax.random.PRNGKey(0))
+    rcnn1, _ = load_checkpoint(prefix + "_rcnn1", 1,
+                               template={"params": t_rcnn1},
+                               means=cfg.train.bbox_means,
+                               stds=cfg.train.bbox_stds,
+                               num_classes=cfg.dataset.num_classes)
+    f_final = final["params"]["features"]
+    f_rcnn1 = rcnn1["params"]["features"]
+    leaf = lambda t: np.asarray(
+        t["stage3"]["block0"]["conv1"]["kernel"])  # noqa: E731
+    np.testing.assert_array_equal(leaf(f_final), leaf(f_rcnn1))
+
+    # combined checkpoint: saved at epoch 0 and eval-able (test_rcnn)
+    result = stages.test_rcnn(cfg, prefix, 0)
+    assert "mAP" in result and np.isfinite(result["mAP"])

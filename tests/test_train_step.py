@@ -97,6 +97,40 @@ def test_train_step_updates_trainable_only(setup):
     assert changed_any, "no trainable parameter changed"
 
 
+def test_frozen_trunk_with_live_grads_stays_fixed():
+    """Freeze via optimizer mask where grads are NONZERO (no stop_gradient
+    cut): the alternate-training stages 4/6 case. optax.masked would pass
+    raw gradients through as updates here (gradient ascent on the 'frozen'
+    trunk — the bug test_stages caught); the optimizer must hard-zero
+    them."""
+    from dataclasses import replace
+
+    cfg = tiny_cfg()
+    cfg = cfg.with_updates(network=replace(
+        cfg.network, norm="group", freeze_at=0,
+        fixed_param_patterns=("features",)))
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+
+    # Sanity: grads through the trunk really are nonzero in this config.
+    grads = jax.grad(lambda p: forward_train(
+        model, p, tiny_batch(1), jax.random.PRNGKey(2), cfg)[0])(params)
+    g = grads["params"]["features"]["stage3"]["block0"]["conv1"]["kernel"]
+    assert float(jnp.abs(g).max()) > 0.0
+
+    tx = build_optimizer(cfg, params, steps_per_epoch=100)
+    state = create_train_state(params, tx)
+    step_fn = make_train_step(model, cfg, mesh=None, donate=False)
+    new_state, _ = step_fn(state, tiny_batch(1), jax.random.PRNGKey(2))
+    old = params["params"]["features"]["stage3"]["block0"]["conv1"]["kernel"]
+    new = new_state.params["params"]["features"]["stage3"]["block0"]["conv1"]["kernel"]
+    np.testing.assert_array_equal(np.asarray(old), np.asarray(new))
+    # ...while the heads trained.
+    assert not np.array_equal(
+        np.asarray(params["params"]["rpn"]["rpn_conv"]["kernel"]),
+        np.asarray(new_state.params["params"]["rpn"]["rpn_conv"]["kernel"]))
+
+
 def test_frozen_mask_covers_reference_prefixes(setup):
     cfg, model, params = setup
     mask = trainable_mask(params, cfg.network.fixed_param_patterns)

@@ -203,8 +203,12 @@ def test_ulysses_attention_matches_dense(rng):
     size must divide 2)."""
     if jax.device_count() < 2:
         pytest.skip("needs 2 devices")
+    # float32 end-to-end: the Ulysses op is exact, but bf16 attention
+    # rounding can flip discrete top-k/NMS selections on some platforms,
+    # making an rtol comparison of the post-selection losses flaky.
     cfg = tiny_cfg(**{"network.use_ring_attention": True,
-                      "network.sp_mode": "ulysses"})
+                      "network.sp_mode": "ulysses",
+                      "network.compute_dtype": "float32"})
     mesh = create_mesh("1x2")
     model_sp = zoo.build_model(cfg, mesh=mesh)
     cfg_dense = cfg.with_updates(

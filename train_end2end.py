@@ -35,7 +35,10 @@ def parse_args():
     p.add_argument("--no_shuffle", action="store_true")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--pretrained", default=None,
-                   help="orbax checkpoint prefix to initialize from")
+                   help="init weights: a .npz ImageNet manifest (see "
+                        "utils/pretrained.py; convert torch checkpoints "
+                        "with utils/torch_convert.py) or an orbax "
+                        "checkpoint prefix")
     p.add_argument("--pretrained_epoch", type=int, default=0)
     p.add_argument("--prefix", default="model/e2e", help="checkpoint prefix")
     p.add_argument("--begin_epoch", type=int, default=0)
@@ -83,7 +86,10 @@ def main():
     logger.info("config: network=%s dataset=%s", args.network, args.dataset)
 
     pretrained = None
-    if args.pretrained:
+    pretrained_npz = None
+    if args.pretrained and args.pretrained.endswith(".npz"):
+        pretrained_npz = args.pretrained  # ImageNet manifest (merged in fit)
+    elif args.pretrained:
         from mx_rcnn_tpu.train.checkpoint import load_checkpoint
         pretrained, _ = load_checkpoint(
             args.pretrained, args.pretrained_epoch,
@@ -98,6 +104,7 @@ def main():
         frequent=args.frequent,
         resume=args.resume,
         pretrained_params=pretrained,
+        pretrained_npz=pretrained_npz,
         mesh_spec=args.tpu_mesh,
     )
 
