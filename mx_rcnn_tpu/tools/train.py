@@ -63,6 +63,7 @@ def fit_detector(
     forward_fn=None,
     loader_factory: Optional[Callable] = None,
     fixed_param_patterns=None,
+    checkpoint_period: int = 1,
 ):
     """Train loop. Returns the final (host) params tree.
 
@@ -167,7 +168,11 @@ def fit_detector(
             bag.update(metrics)
             speedometer(epoch, i, bag)
         logger.info("Epoch[%d] done. %s", epoch, bag.format())
-        if is_primary():  # multi-host: one writer (params are replicated)
+        # checkpoint_period > 1 (long small-epoch runs, e.g. the DETR
+        # gate's 150 epochs): save every Nth epoch and always the last —
+        # resume granularity traded against orbax save time.
+        if is_primary() and ((epoch + 1) % max(1, checkpoint_period) == 0
+                             or epoch + 1 == end_epoch):
             save_checkpoint(
                 prefix, epoch + 1, state.params, state.opt_state,
                 means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,

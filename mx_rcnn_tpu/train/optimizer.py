@@ -94,11 +94,23 @@ def build_optimizer(cfg: Config, params, steps_per_epoch: int = 1000,
                     begin_step: int = 0):
     mask = trainable_mask(params, effective_fixed_patterns(cfg))
     sched = lr_schedule(cfg, steps_per_epoch, begin_step)
-    inner = optax.chain(
-        optax.clip(cfg.train.clip_gradient),
-        optax.add_decayed_weights(cfg.train.wd),
-        optax.sgd(learning_rate=sched, momentum=cfg.train.momentum),
-    )
+    if cfg.train.optimizer == "adamw":
+        # Transformer families (DETR/ViTDet): AdamW + global-norm clip,
+        # per their papers. Weight decay is decoupled (inside adamw).
+        inner = optax.chain(
+            optax.clip_by_global_norm(cfg.train.clip_gradient),
+            optax.adamw(learning_rate=sched, weight_decay=cfg.train.wd),
+        )
+    elif cfg.train.optimizer == "sgd":
+        inner = optax.chain(
+            optax.clip(cfg.train.clip_gradient),
+            optax.add_decayed_weights(cfg.train.wd),
+            optax.sgd(learning_rate=sched, momentum=cfg.train.momentum),
+        )
+    else:
+        raise ValueError(
+            f"train.optimizer must be 'sgd' or 'adamw', got "
+            f"{cfg.train.optimizer!r}")
     # NOT optax.masked(inner, mask): masked() passes the RAW GRADIENT
     # through for masked-out leaves (optax's contract), which apply_updates
     # would then ADD to the frozen params — gradient ascent. Harmless only

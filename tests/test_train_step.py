@@ -131,6 +131,44 @@ def test_frozen_trunk_with_live_grads_stays_fixed():
         np.asarray(new_state.params["params"]["rpn"]["rpn_conv"]["kernel"]))
 
 
+def test_adamw_optimizer_knob():
+    """train.optimizer='adamw' (the DETR/ViTDet preset): builds, steps,
+    and still hard-zeros frozen leaves."""
+    from dataclasses import replace
+
+    cfg = tiny_cfg()
+    cfg = cfg.with_updates(train=replace(cfg.train, optimizer="adamw",
+                                         lr=1e-4, clip_gradient=0.1))
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    tx = build_optimizer(cfg, params, steps_per_epoch=10)
+    state = create_train_state(params, tx)
+    step_fn = make_train_step(model, cfg, mesh=None, donate=False)
+    new_state, metrics = step_fn(state, tiny_batch(1), jax.random.PRNGKey(2))
+    assert np.isfinite(float(metrics["TotalLoss"]))
+    # frozen stem stays fixed under adamw too
+    old = params["params"]["features"]["conv0"]["kernel"]
+    new = new_state.params["params"]["features"]["conv0"]["kernel"]
+    np.testing.assert_array_equal(np.asarray(old), np.asarray(new))
+    # trainable heads moved
+    assert not np.array_equal(
+        np.asarray(params["params"]["rpn"]["rpn_conv"]["kernel"]),
+        np.asarray(new_state.params["params"]["rpn"]["rpn_conv"]["kernel"]))
+
+    with pytest.raises(ValueError, match="sgd.*adamw|adamw.*sgd"):
+        bad = cfg.with_updates(train=replace(cfg.train, optimizer="lion"))
+        build_optimizer(bad, params)
+
+
+def test_transformer_presets_use_adamw():
+    from mx_rcnn_tpu.config import generate_config as gc
+
+    assert gc("detr_r50", "coco").train.optimizer == "adamw"
+    assert gc("vitdet_b", "coco").train.optimizer == "adamw"
+    assert gc("resnet101", "coco").train.optimizer == "sgd"
+    assert gc("resnet101_fpn", "coco").train.optimizer == "sgd"
+
+
 def test_frozen_mask_covers_reference_prefixes(setup):
     cfg, model, params = setup
     mask = trainable_mask(params, cfg.network.fixed_param_patterns)
