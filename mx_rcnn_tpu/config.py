@@ -386,6 +386,16 @@ def generate_config(network: str, dataset: str, **overrides) -> Config:
         if (("image.scales" in overrides or "image.pad_shape" in overrides)
                 and "image.pad_shapes" not in overrides):
             overrides = dict(overrides, **{"image.pad_shapes": ()})
+        if ("image.pad_shape" in overrides
+                and "image.scales" not in overrides):
+            # pad_shape-only override: the preset scales may exceed the
+            # new canvas (the FPN presets' (800,1333) against a 640-pad
+            # would crash pad_image mid-epoch). The canvas IS the intent:
+            # train at the pad-sized scale.
+            ph, pw = overrides["image.pad_shape"]
+            overrides = dict(
+                overrides,
+                **{"image.scales": ((min(ph, pw), max(ph, pw)),)})
         cfg = _apply_dotted_overrides(cfg, overrides)
     return cfg
 

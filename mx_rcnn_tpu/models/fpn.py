@@ -38,9 +38,7 @@ from mx_rcnn_tpu.models.losses import rcnn_losses, rpn_losses
 from mx_rcnn_tpu.models.rpn import RPNHead
 from mx_rcnn_tpu.ops.anchors import anchor_grid
 from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
-from mx_rcnn_tpu.ops.nms import nms, nms_bitmask
-from mx_rcnn_tpu.ops.nms_pallas import batched_nms
-from mx_rcnn_tpu.ops.proposal import _BITMASK_NMS_MAX_BOXES
+from mx_rcnn_tpu.ops.nms import nms_dispatch
 from mx_rcnn_tpu.ops.proposal import _decode_one_image
 from mx_rcnn_tpu.ops.roi_align import roi_align
 from mx_rcnn_tpu.targets.rcnn_targets import sample_rois
@@ -277,8 +275,8 @@ def fpn_proposals(
     scores = jnp.concatenate(scores_all, axis=1)
     valid = jnp.concatenate(valid_all, axis=1)
 
-    keep_idx, keep_valid = _nms_dispatch(boxes, scores, valid,
-                                         tc.rpn_nms_thresh, post)
+    keep_idx, keep_valid = nms_dispatch(boxes, scores, valid,
+                                        tc.rpn_nms_thresh, post)
     rois = jnp.take_along_axis(boxes, keep_idx[..., None], axis=1)
     kept_scores = jnp.take_along_axis(scores, keep_idx, axis=1)
     roi_scores = jnp.where(keep_valid, kept_scores, 0.0)
@@ -295,7 +293,7 @@ def per_level_nms_union(boxes_all, scores_all, valid_all,
     validity. Returns (rois (B, post, 4), keep_valid, roi_scores)."""
     kept_boxes, kept_scores = [], []
     for bl, sl, vl in zip(boxes_all, scores_all, valid_all):
-        idx, kv = _nms_dispatch(bl, sl, vl, thresh, bl.shape[1])
+        idx, kv = nms_dispatch(bl, sl, vl, thresh, bl.shape[1])
         kept_boxes.append(jnp.take_along_axis(bl, idx[..., None], axis=1))
         sk = jnp.take_along_axis(sl, idx, axis=1)
         # -1 marks suppressed/invalid slots out of the union top-k
@@ -311,18 +309,6 @@ def per_level_nms_union(boxes_all, scores_all, valid_all,
     return rois, keep_valid, roi_scores
 
 
-def _nms_dispatch(boxes, scores, valid, thresh: float, max_out: int):
-    """Pallas NMS on TPU, bitmask jnp elsewhere — with ops/proposal.py's
-    large-N guard: past _BITMASK_NMS_MAX_BOXES the N×N suppression matrix
-    (10k² floats per image on the joint-union path) costs more than the
-    O(N·max_out) iterative formulation."""
-    if jax.default_backend() == "tpu":
-        return batched_nms(boxes, scores, valid, thresh, max_out)
-    n = boxes.shape[1]
-    nms_fn = nms_bitmask if n <= _BITMASK_NMS_MAX_BOXES else nms
-    return jax.vmap(
-        partial(nms_fn, iou_threshold=thresh, max_output=max_out)
-    )(boxes, scores, valid)
 
 
 def _rpn_softmax_fg(cls_logits: jnp.ndarray, num_anchors: int) -> jnp.ndarray:

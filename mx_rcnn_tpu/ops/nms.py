@@ -138,3 +138,36 @@ def nms_bitmask(
     out_idx = out_idx.at[slot].set(order.astype(jnp.int32), mode="drop")
     out_valid = out_valid.at[slot].set(True, mode="drop")
     return out_idx, out_valid
+
+
+# Past this size the (N, N) bitmask suppression matrix costs more than the
+# O(N·max_output) iterative formulation (measured crossover region on CPU;
+# the Pallas kernel owns the TPU path regardless).
+BITMASK_NMS_MAX_BOXES = 6144
+
+
+def nms_dispatch(boxes, scores, valid, iou_threshold: float,
+                 max_output: int, impl: str = "auto"):
+    """THE batched NMS policy, shared by every proposal path
+    (ops/proposal.py, models/fpn.py): Pallas on TPU, jnp elsewhere with
+    the bitmask-vs-iterative size guard.
+
+    boxes (B, N, 4), scores (B, N), valid (B, N) → (keep_idx (B, max_output),
+    keep_valid (B, max_output)).
+    impl: "auto" | "pallas" | "xla".
+    """
+    from functools import partial
+
+    from mx_rcnn_tpu.ops.nms_pallas import batched_nms
+
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return batched_nms(boxes, scores, valid, iou_threshold, max_output)
+    if impl == "xla":
+        nms_fn = (nms_bitmask if boxes.shape[1] <= BITMASK_NMS_MAX_BOXES
+                  else nms)
+        return jax.vmap(
+            partial(nms_fn, iou_threshold=iou_threshold,
+                    max_output=max_output))(boxes, scores, valid)
+    raise ValueError(f"unknown nms impl {impl!r}")

@@ -37,13 +37,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
-from mx_rcnn_tpu.ops.nms import nms, nms_bitmask
-from mx_rcnn_tpu.ops.nms_pallas import batched_nms
+from mx_rcnn_tpu.ops.nms import BITMASK_NMS_MAX_BOXES, nms_dispatch
 
-# Above this many candidate boxes the O(N²) bitmask IoU matrix (~N²·4 bytes
-# plus same-shape temporaries) stops fitting comfortably next to backbone
-# activations in HBM; fall back to the O(max_output·N) iterative kernel.
-_BITMASK_NMS_MAX_BOXES = 6144
+# Backwards-compat alias; the policy (and the guard rationale) lives in
+# ops/nms.py::nms_dispatch now.
+_BITMASK_NMS_MAX_BOXES = BITMASK_NMS_MAX_BOXES
 
 
 def generate_proposals(
@@ -94,18 +92,9 @@ def generate_proposals(
         in_axes=(0, 0, 0, None),
     )(scores, deltas, im_info, anchors)
 
-    if nms_impl == "auto":
-        nms_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
-    if nms_impl == "pallas":
-        keep_idx, keep_valid = batched_nms(
-            top_boxes, top_scores, top_valid, nms_thresh, post_nms_top_n)
-    elif nms_impl == "xla":
-        nms_fn = nms_bitmask if k <= _BITMASK_NMS_MAX_BOXES else nms
-        keep_idx, keep_valid = jax.vmap(
-            partial(nms_fn, iou_threshold=nms_thresh, max_output=post_nms_top_n)
-        )(top_boxes, top_scores, top_valid)
-    else:
-        raise ValueError(f"unknown nms_impl {nms_impl!r}")
+    keep_idx, keep_valid = nms_dispatch(
+        top_boxes, top_scores, top_valid, nms_thresh, post_nms_top_n,
+        impl=nms_impl)
 
     rois = jnp.take_along_axis(top_boxes, keep_idx[..., None], axis=1)
     kept_scores = jnp.take_along_axis(top_scores, keep_idx, axis=1)

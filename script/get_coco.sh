@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Fetch COCO 2017 images + annotations into data/coco (reference:
+# script/get_coco.sh). Requires network access — this CI container is
+# offline; the script is the pinned recipe for a connected machine.
+# Layout consumed by mx_rcnn_tpu.data.datasets.coco:
+#   data/coco/annotations/instances_{train,val}2017.json
+#   data/coco/{train2017,val2017}/*.jpg
+set -euo pipefail
+mkdir -p data/coco && cd data/coco
+
+for z in train2017.zip val2017.zip; do
+  [ -d "${z%.zip}" ] || { curl -L -O "http://images.cocodataset.org/zips/$z"; unzip -q "$z"; }
+done
+[ -d annotations ] || {
+  curl -L -O http://images.cocodataset.org/annotations/annotations_trainval2017.zip
+  unzip -q annotations_trainval2017.zip
+}
+echo "COCO 2017 ready under data/coco"

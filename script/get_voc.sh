@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Fetch PASCAL VOC 2007(+2012) into data/VOCdevkit (reference:
+# script/get_voc.sh). Requires network access — this CI container is
+# offline; the script is the pinned recipe for a connected machine.
+# Layout consumed by mx_rcnn_tpu.data.datasets.pascal_voc:
+#   data/VOCdevkit/VOC2007/{Annotations,ImageSets,JPEGImages}
+#   data/VOCdevkit/VOC2012/...
+set -euo pipefail
+mkdir -p data && cd data
+
+BASE=http://host.robots.ox.ac.uk/pascal/VOC/voc2007
+for f in VOCtrainval_06-Nov-2007.tar VOCtest_06-Nov-2007.tar; do
+  [ -f "$f" ] || curl -L -O "$BASE/$f"
+  tar -xf "$f"
+done
+if [ "${WITH_VOC2012:-0}" = "1" ]; then
+  f=VOCtrainval_11-May-2012.tar
+  [ -f "$f" ] || curl -L -O \
+    http://host.robots.ox.ac.uk/pascal/VOC/voc2012/$f
+  tar -xf "$f"
+fi
+echo "VOC ready under data/VOCdevkit"

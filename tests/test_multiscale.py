@@ -64,6 +64,10 @@ def test_override_consistency_drops_preset_buckets():
                           **{"image.pad_shape": (640, 1024)})
     assert cfg.image.pad_shapes == ()
     assert pad_shape_for(cfg, 0) == (640, 1024)  # not the 1088 bucket
+    # ...and the preset's (800,1333) scale must NOT survive a pad-only
+    # override: it would overflow the 640 canvas mid-epoch. The canvas
+    # defines the single training scale.
+    assert cfg.image.scales == ((640, 1024),)
     # same-length scales override: stale buckets must not survive either
     cfg2 = generate_config("resnet101_fpn", "coco",
                            **{"image.scales": ((1000, 1666), (1200, 2000))})
@@ -165,6 +169,37 @@ def test_portrait_batch_is_transpose_padded():
     # portrait 128x64: scale = min(96/64, 160/128) = 1.25 -> 160x80,
     # padded into the TRANSPOSED (160, 96) bucket, not a 160x160 square
     assert batch["image"].shape[1:3] == (160, 96)
+
+
+def test_testloader_orientation_grouped_batches():
+    """batch_size>1 eval: landscape-first ordering keeps batches
+    orientation-pure (rectangular buckets, not the square mixed cover);
+    metas still carry original indices so detections stay aligned."""
+    cfg = generate_config("resnet50_fpn", "synthetic", **dict(
+        TWO_SCALE, **{"image.scales": ((96, 160),),
+                      "image.pad_shapes": ((96, 160),),
+                      "image.pad_shape": (160, 160)}))
+    ds = SyntheticDataset("train", num_images=6, image_size=128,
+                          max_objects=2, min_size_frac=4, max_size_frac=2)
+    roidb = []
+    for i, entry in enumerate(ds.gt_roidb()):
+        e = dict(entry)
+        if i % 2:  # alternate portrait/landscape in index order
+            e["image_data"] = entry["image_data"][:, :64]
+            e["boxes"] = np.clip(entry["boxes"], 0,
+                                 [63, 127, 63, 127]).astype(np.float32)
+            e["width"], e["height"] = 64, 128
+        roidb.append(e)
+    loader = TestLoader(roidb, cfg, batch_size=3)
+    got = []
+    seen_idx = set()
+    for batch, metas in loader:
+        got.append(batch["image"].shape[1:3])
+        seen_idx.update(m["index"] for m in metas if m["real"])
+    # interleaved input → grouped output: one pure-landscape batch
+    # ((96,160) bucket) + one pure-portrait ((160,96)), no square batch
+    assert sorted(got) == [(96, 160), (160, 96)], got
+    assert seen_idx == set(range(6))  # every image evaluated exactly once
 
 
 def test_testloader_uses_largest_scale():
