@@ -137,9 +137,9 @@ class TrainConfig:
     # FPN proposal budget per pyramid level (Detectron convention: 2000/level
     # at train time); only read when network.use_fpn.
     fpn_rpn_pre_nms_per_level: int = 2000
-    # FPN RPN NMS scope: per-level (the Detectron-lineage semantics; 5
-    # small NMS problems, measured ~5 ms cheaper than one joint NMS over
-    # the 10k-candidate union at train sizes — PERF.md) or joint across
+    # FPN RPN NMS scope: per-level (True — the Detectron-lineage
+    # semantics; measured equal in cost to one joint NMS over the
+    # 10k-candidate union at v5e train sizes, PERF.md) or joint across
     # the union (False).
     fpn_nms_per_level: bool = True
     # Mask target rasterization resolution (gt instance masks are stored

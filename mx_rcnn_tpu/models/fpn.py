@@ -16,8 +16,10 @@ Level layout:
   [2, 5] (FPN Eq. 1), pooled 7x7 from the assigned level.
 
 Static-shape strategy: proposals are decoded + top-k'd per level (a fixed
-per-level budget), concatenated, and suppressed with ONE joint NMS — the
-union-NMS variant of the FPN paper — so every shape is compile-time fixed.
+per-level budget), NMS'd within each level, and the top post_nms of the
+score-ranked union is taken (Detectron-lineage semantics; the joint
+union-NMS variant stays available via fpn_nms_per_level=False) — every
+shape is compile-time fixed either way.
 ROI-to-level assignment computes the cheap matmul pool on EVERY level and
 selects by mask (4 levels × a 13 GFLOP/step op beats any dynamic gather).
 """
@@ -239,7 +241,8 @@ def fpn_proposals(
     train: bool,
 ):
     """Multi-level proposal generation: per-level decode + top-k, concat,
-    joint NMS (union variant), top post_nms_top_n.
+    NMS per level or jointly over the union (tc.fpn_nms_per_level), top
+    post_nms_top_n.
 
     Returns rois (B, post, 4), roi_valid (B, post), roi_scores (B, post).
     """
