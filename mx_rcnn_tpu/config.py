@@ -73,6 +73,11 @@ class NetworkConfig:
     # the mesh model-axis size).
     use_ring_attention: bool = False
     sp_mode: str = "ring"
+    # Tensor parallelism over the mesh `model` axis (parallel/partition.py):
+    # Megatron-split transformer MLP/attention weights and the paired
+    # fc6/fc7 detection heads; GSPMD inserts the collectives. Composes
+    # with DP (data axis) and SP (same model axis, different tensors).
+    tensor_parallel: bool = False
     # DETR (stretch config; models/detr.py).
     use_detr: bool = False
     detr_queries: int = 100
@@ -413,7 +418,45 @@ def _apply_dotted_overrides(cfg: Config, overrides: Mapping[str, Any]) -> Config
     for section, value in grouped.items():
         current = getattr(cfg, section)
         if isinstance(value, Mapping) and dataclasses.is_dataclass(current):
+            for leaf, leaf_value in value.items():
+                # A string landing on a bool field is always a mistake
+                # (e.g. a CLI "false" that failed literal parsing would be
+                # TRUTHY); fail loudly instead of silently enabling it.
+                if isinstance(getattr(current, leaf, None), bool) and isinstance(
+                        leaf_value, str):
+                    raise ValueError(
+                        f"override {section}.{leaf}={leaf_value!r}: field is "
+                        f"a bool; pass True/False")
             updates[section] = replace(current, **value)
         else:
             updates[section] = value
     return replace(cfg, **updates)
+
+
+def parse_cli_overrides(pairs) -> dict:
+    """['a.b=1', ...] (the CLI --set flag) → {'a.b': 1}.
+
+    Values parse as python literals; the common CLI bool spellings
+    (true/false/yes/no/on/off, any case) map to real bools BEFORE the
+    literal fallback so '--set network.tensor_parallel=false' can never
+    come through as a truthy string; anything else unparseable stays a
+    string (e.g. network.norm=group).
+    """
+    import ast
+
+    out = {}
+    for pair in pairs:
+        key, sep, raw = pair.partition("=")
+        if not sep or not key:
+            raise ValueError(f"--set expects KEY=VALUE, got {pair!r}")
+        low = raw.strip().lower()
+        if low in ("true", "yes", "on"):
+            out[key] = True
+        elif low in ("false", "no", "off"):
+            out[key] = False
+        else:
+            try:
+                out[key] = ast.literal_eval(raw)
+            except (ValueError, SyntaxError):
+                out[key] = raw
+    return out

@@ -13,6 +13,12 @@ from mx_rcnn_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
+from mx_rcnn_tpu.parallel.partition import (
+    TP_RULES,
+    shard_params,
+    shard_train_state,
+    tp_param_specs,
+)
 
 __all__ = [
     "create_mesh",
@@ -20,4 +26,8 @@ __all__ = [
     "batch_sharding",
     "replicated",
     "shard_batch",
+    "TP_RULES",
+    "tp_param_specs",
+    "shard_params",
+    "shard_train_state",
 ]

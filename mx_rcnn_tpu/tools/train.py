@@ -154,8 +154,22 @@ def fit_detector(
             step=jax.numpy.asarray(begin_epoch * steps_per_epoch,
                                    jax.numpy.int32))
 
+    param_specs = None
+    if cfg.network.tensor_parallel:
+        if mesh.shape["model"] > 1:
+            from mx_rcnn_tpu.parallel.partition import (
+                shard_train_state, tp_param_specs)
+
+            param_specs = tp_param_specs(state.params)
+            state = shard_train_state(state, mesh, param_specs)
+        else:
+            logger.warning(
+                "network.tensor_parallel ignored: mesh model axis is 1 "
+                "(build the mesh as '<data>x<model>', e.g. --tpu-mesh 4x2)")
+
     step_fn = make_train_step(model, cfg, mesh=mesh,
-                              forward_fn=forward_fn or forward_train)
+                              forward_fn=forward_fn or forward_train,
+                              param_specs=param_specs)
     rng = jax.random.PRNGKey(seed + 1)
     batch_size = cfg.train.batch_images * n_data
     speedometer = Speedometer(batch_size, frequent)

@@ -88,6 +88,7 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     donate: bool = True,
     forward_fn: Callable = forward_train,
+    param_specs=None,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray], jax.Array],
               Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the jitted train step.
@@ -97,6 +98,11 @@ def make_train_step(
     Without: plain single-device jit. forward_fn selects the training graph
     (end2end / rpn-only / rcnn-only — the reference's get_*_train symbol
     variants).
+
+    param_specs (parallel/partition.py): tensor-parallel weight shardings.
+    The state must then arrive PRE-PLACED (shard_train_state) — shardings
+    are inferred from the committed inputs and propagated by GSPMD, which
+    inserts the TP collectives alongside the data-axis gradient psum.
     """
 
     def step(state: TrainState, batch, rng):
@@ -109,6 +115,11 @@ def make_train_step(
         return new_state, _metrics_from_aux(aux)
 
     if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    if param_specs is not None:
+        # TP: respect the committed shardings of state (mixed sharded/
+        # replicated leaves) and batch; outputs keep propagated layouts.
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
     repl = NamedSharding(mesh, P())

@@ -10,7 +10,7 @@ import argparse
 
 import jax
 
-from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.config import generate_config, parse_cli_overrides
 from mx_rcnn_tpu.data.datasets import dataset_from_config
 from mx_rcnn_tpu.data.loader import TestLoader
 from mx_rcnn_tpu.evaluation.tester import Predictor, pred_eval
@@ -36,6 +36,10 @@ def parse_args():
     p.add_argument("--from-scratch", dest="from_scratch", action="store_true",
                    help="match a train_end2end.py --from-scratch checkpoint "
                         "(GroupNorm backbone)")
+    p.add_argument("--set", dest="set_cfg", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="dotted config override, repeatable (must match "
+                        "the training overrides that shape the graph)")
     return p.parse_args()
 
 
@@ -49,6 +53,7 @@ def main():
     if args.from_scratch:
         overrides["network.norm"] = "group"
         overrides["network.freeze_at"] = 0
+    overrides.update(parse_cli_overrides(args.set_cfg))
     cfg = generate_config(args.network, args.dataset, **overrides)
     image_set = args.image_set or cfg.dataset.test_image_set
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import argparse
 
-from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.config import generate_config, parse_cli_overrides
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.tools.train import fit_detector, load_gt_roidbs
 
@@ -52,6 +52,12 @@ def parse_args():
                         "frozen prefix (frozen-BN with identity statistics "
                         "is unstable — see models/backbones.py). The "
                         "matching test.py run needs the same flag.")
+    p.add_argument("--set", dest="set_cfg", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="dotted config override, repeatable — e.g. "
+                        "--set network.tensor_parallel=true "
+                        "--set train.batch_images=2 (values parsed as "
+                        "python literals / bool words, else kept as strings)")
     return p.parse_args()
 
 
@@ -82,6 +88,7 @@ def main():
     if args.from_scratch:
         overrides["network.norm"] = "group"
         overrides["network.freeze_at"] = 0
+    overrides.update(parse_cli_overrides(args.set_cfg))
     cfg = generate_config(args.network, args.dataset, **overrides)
     logger.info("config: network=%s dataset=%s", args.network, args.dataset)
 
