@@ -63,6 +63,9 @@ TP_RULES: Tuple[Tuple[str, P], ...] = (
     ("*_attn/v/kernel", P(None, "model")),
     ("*_attn/v/bias", P("model")),
     ("*_attn/proj/kernel", P("model", None)),
+    ("*/ffn1/kernel", P(None, "model")),
+    ("*/ffn1/bias", P("model")),
+    ("*/ffn2/kernel", P("model", None)),
     # Paired FC detection heads: TwoFCHead (models/fpn.py) and VGGHead
     # (models/backbones.py fc6/fc7 — reference symbol_vgg.py's 4096-wide
     # pair, the one genuinely large dense matrix in the classic family).

@@ -22,6 +22,14 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's wall time is dominated by XLA
+# re-compiles of the same jitted steps across test processes/runs; cache
+# them on disk (tests/.jax_cache, gitignored) so repeat runs pay tracing
+# only. Threshold 0.1s keeps only trivial kernels out of the cache.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
 import numpy as np
 import pytest
 

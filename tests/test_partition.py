@@ -182,6 +182,10 @@ def test_detr_tp_step_matches_replicated(rng):
     specs = _flat(tp_param_specs(params))
     assert specs["params/enc0/self_attn/q/kernel"] == P(None, "model")
     assert specs["params/dec0/cross_attn/proj/kernel"] == P("model", None)
+    # The FFN pair holds the largest DETR matrices — it MUST be split.
+    assert specs["params/enc0/ffn1/kernel"] == P(None, "model")
+    assert specs["params/enc0/ffn2/kernel"] == P("model", None)
+    assert specs["params/dec0/ffn1/kernel"] == P(None, "model")
     batch = _batch(rng)
 
     ref_losses, _ = _run_steps(cfg, params, batch)
