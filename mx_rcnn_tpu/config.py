@@ -78,6 +78,14 @@ class NetworkConfig:
     # fc6/fc7 detection heads; GSPMD inserts the collectives. Composes
     # with DP (data axis) and SP (same model axis, different tensors).
     tensor_parallel: bool = False
+    # Pipeline parallelism for the ViT encoder (parallel/pipeline.py):
+    # pp_stages > 0 selects the staged backbone (ViTBackbonePP; depth must
+    # divide; each stage ends with a global-attention block — stages_n=4
+    # reproduces the ViTDet pattern) pipelined over the mesh `model` axis
+    # (whose size must equal pp_stages). Mutually exclusive with SP.
+    # pp_microbatches=0 → one microbatch per stage.
+    pp_stages: int = 0
+    pp_microbatches: int = 0
     # DETR (stretch config; models/detr.py).
     use_detr: bool = False
     detr_queries: int = 100

@@ -156,6 +156,89 @@ class ViTBackbone(nn.Module):
         return x
 
 
+class ViTStage(nn.Module):
+    """One pipeline stage: (blocks-1) windowed Blocks + a global tail.
+
+    The ViTDet quarter pattern — every depth/4 subset ends with a global
+    block — makes the encoder a stack of IDENTICALLY-STRUCTURED stages,
+    which is exactly what pipeline parallelism needs (ring-homogeneous,
+    shape-preserving). nn.scan-compatible signature: (carry, None) ->
+    (carry, None).
+    """
+
+    dim: int
+    heads: int
+    window: int
+    blocks: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, _=None):
+        for i in range(self.blocks - 1):
+            x = Block(self.dim, self.heads, window=self.window,
+                      dtype=self.dtype, name=f"win{i}")(x)
+        x = Block(self.dim, self.heads, window=0, dtype=self.dtype,
+                  name="glob")(x)
+        return x, None
+
+
+class ViTBackbonePP(nn.Module):
+    """Plain-ViT encoder with a STAGED block stack for pipeline parallelism.
+
+    Same embed/norm surface as ViTBackbone, but the depth is organized as
+    ``stages_n`` scanned ViTStages (params stacked on a leading stage axis
+    by nn.scan). Sequential execution (pipeline_fn=None) and pipelined
+    execution (parallel/pipeline.py::pipeline_apply over the mesh `model`
+    axis) share the SAME parameters and numerics; with stages_n=4 and
+    blocks_per_stage=depth/4 the global-attention placement matches
+    ViTBackbone's ViTDet pattern exactly.
+    """
+
+    patch: int = 16
+    dim: int = 768
+    stages_n: int = 4
+    blocks_per_stage: int = 3
+    heads: int = 12
+    window: int = 8
+    dtype: Dtype = jnp.bfloat16
+    pos_grid: int = 32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, pipeline_fn=None) -> jnp.ndarray:
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), dtype=self.dtype,
+                    param_dtype=jnp.float32, name="patch_embed")(
+                        x.astype(self.dtype))
+        h, w = x.shape[1], x.shape[2]
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.pos_grid, self.pos_grid, self.dim),
+                         jnp.float32)
+        pos = jax.image.resize(pos, (1, h, w, self.dim), "bilinear")
+        x = x + pos.astype(self.dtype)
+
+        stage_kw = dict(dim=self.dim, heads=self.heads, window=self.window,
+                        blocks=self.blocks_per_stage, dtype=self.dtype)
+        ScanStages = nn.scan(
+            ViTStage, variable_axes={"params": 0},
+            split_rngs={"params": True}, length=self.stages_n)
+        stages = ScanStages(**stage_kw, name="stages")
+        if pipeline_fn is None or self.is_initializing():
+            # Sequential nn.scan — also the init path (creates the stacked
+            # params the pipeline slices per stage).
+            x, _ = stages(x, None)
+        else:
+            stacked = self.variables["params"]["stages"]
+            stage = ViTStage(**stage_kw)
+
+            def stage_fn(p, h_act):
+                y, _ = stage.apply({"params": p}, h_act)
+                return y
+
+            x = pipeline_fn(stage_fn, stacked, x)
+        return nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                            name="norm")(x)
+
+
 class SimpleFeaturePyramid(nn.Module):
     """ViTDet SFP: stride-16 map → {P2..P6} 256-channel pyramid."""
 
@@ -220,11 +303,27 @@ class ViTDet(nn.Module):
     # (q, k, v) -> out, typically partial(ring_attention, mesh=mesh).
     # Static (non-pytree) module field.
     global_attn_fn: Optional[Any] = None
+    # Pipeline parallelism (mutually exclusive with global_attn_fn — both
+    # own the mesh `model` axis): number of encoder stages, and the
+    # executor (stage_fn, stacked_params, x) -> x built over the mesh
+    # (parallel/pipeline.py). pp_stages > 0 selects ViTBackbonePP.
+    pp_stages: int = 0
+    pipeline_fn: Optional[Any] = None
 
     def setup(self):
-        self.features = ViTBackbone(patch=self.patch, dim=self.dim,
-                                    depth=self.depth, heads=self.heads,
-                                    window=self.window, dtype=self.dtype)
+        if self.pp_stages:
+            if self.depth % self.pp_stages:
+                raise ValueError(
+                    f"vit_depth {self.depth} must divide into pp_stages "
+                    f"{self.pp_stages}")
+            self.features = ViTBackbonePP(
+                patch=self.patch, dim=self.dim, stages_n=self.pp_stages,
+                blocks_per_stage=self.depth // self.pp_stages,
+                heads=self.heads, window=self.window, dtype=self.dtype)
+        else:
+            self.features = ViTBackbone(patch=self.patch, dim=self.dim,
+                                        depth=self.depth, heads=self.heads,
+                                        window=self.window, dtype=self.dtype)
         self.neck = SimpleFeaturePyramid(channels=self.fpn_channels,
                                          dtype=self.dtype)
         self.rpn = RPNHead(num_anchors=self.num_anchors,
@@ -241,7 +340,10 @@ class ViTDet(nn.Module):
                                       dtype=self.dtype)
 
     def extract(self, images: jnp.ndarray) -> Dict[int, jnp.ndarray]:
-        feat = self.features(images, self.global_attn_fn)
+        if self.pp_stages:
+            feat = self.features(images, self.pipeline_fn)
+        else:
+            feat = self.features(images, self.global_attn_fn)
         return self.neck(feat)
 
     def rpn_forward(self, pyramid: Dict[int, jnp.ndarray]):
@@ -271,7 +373,29 @@ class ViTDet(nn.Module):
         return outs
 
 
-def build_vitdet_model(cfg: Config, global_attn_fn=None) -> ViTDet:
+def build_vitdet_model(cfg: Config, global_attn_fn=None,
+                       pipeline_fn=None) -> ViTDet:
+    pp_stages = cfg.network.pp_stages
+    if pp_stages and global_attn_fn is not None:
+        raise ValueError(
+            "pp_stages and sequence-parallel attention both claim the mesh "
+            "'model' axis; enable one of network.pp_stages / "
+            "network.use_ring_attention")
+    if pp_stages and cfg.network.tensor_parallel:
+        raise ValueError(
+            "network.tensor_parallel and network.pp_stages both claim the "
+            "mesh 'model' axis (TP rules would shard the stacked STAGE "
+            "axis of the scanned stage params); enable only one")
+    if pp_stages and pp_stages != 4:
+        from mx_rcnn_tpu.logger import logger
+
+        logger.warning(
+            "pp_stages=%d: the staged backbone places ONE global-attention "
+            "block per stage (at each stage tail), so this is a different "
+            "global placement than ViTBackbone's depth/4 pattern — "
+            "checkpoints/accuracy are not comparable to the non-PP model; "
+            "pp_stages=4 reproduces the ViTDet architecture exactly",
+            pp_stages)
     return ViTDet(
         num_classes=cfg.dataset.num_classes,
         num_anchors=cfg.network.num_anchors,
@@ -286,6 +410,8 @@ def build_vitdet_model(cfg: Config, global_attn_fn=None) -> ViTDet:
         window=cfg.network.vit_window,
         dtype=jnp.dtype(cfg.network.compute_dtype),
         global_attn_fn=global_attn_fn,
+        pp_stages=pp_stages,
+        pipeline_fn=pipeline_fn,
     )
 
 

@@ -82,6 +82,10 @@ def build_model(cfg: Config, mesh=None):
                     f"({cfg.network.vit_heads}) divisible by the mesh "
                     f"model axis ({mesh.shape['model']}); use the ring "
                     "formulation for head-indivisible layouts")
+            if cfg.network.pp_stages:
+                raise ValueError(
+                    "network.pp_stages and sequence parallelism both claim "
+                    "the mesh 'model' axis; enable only one")
             sp = (ulysses_attention if cfg.network.sp_mode == "ulysses"
                   else ring_attention)
             attn_fn = partial(sp, mesh=mesh, axis="model")
@@ -96,7 +100,31 @@ def build_model(cfg: Config, mesh=None):
                 "ignored: build_model() was called without a mesh; using "
                 "dense attention (same numerics, no SP)",
                 cfg.network.use_ring_attention, cfg.network.sp_mode)
-        return _vit.build_vitdet_model(cfg, global_attn_fn=attn_fn)
+        pipeline_fn = None
+        if cfg.network.pp_stages and mesh is not None:
+            if "model" not in mesh.axis_names or (
+                    mesh.shape["model"] != cfg.network.pp_stages):
+                raise ValueError(
+                    f"network.pp_stages={cfg.network.pp_stages} needs a "
+                    f"mesh model axis of that size; got "
+                    f"{dict(zip(mesh.axis_names, mesh.devices.shape))}. "
+                    "Build the mesh as '<data>x<stages>' "
+                    f"(e.g. --tpu-mesh 2x{cfg.network.pp_stages})")
+            from mx_rcnn_tpu.parallel.pipeline import pipeline_apply
+
+            def pipeline_fn(stage_fn, stacked, x, _mesh=mesh):
+                return pipeline_apply(
+                    stage_fn, stacked, x, _mesh, axis="model",
+                    microbatches=cfg.network.pp_microbatches or None)
+        elif cfg.network.pp_stages:
+            from mx_rcnn_tpu.logger import logger
+
+            logger.warning(
+                "network.pp_stages=%d: no mesh at build time — running the "
+                "staged backbone SEQUENTIALLY (same params and numerics, "
+                "no pipelining)", cfg.network.pp_stages)
+        return _vit.build_vitdet_model(cfg, global_attn_fn=attn_fn,
+                                       pipeline_fn=pipeline_fn)
     if cfg.network.use_fpn:
         return _fpn.build_fpn_model(cfg)
     return _c4.build_model(cfg)

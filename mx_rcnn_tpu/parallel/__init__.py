@@ -19,6 +19,7 @@ from mx_rcnn_tpu.parallel.partition import (
     shard_train_state,
     tp_param_specs,
 )
+from mx_rcnn_tpu.parallel.pipeline import pipeline_apply
 
 __all__ = [
     "create_mesh",
@@ -30,4 +31,5 @@ __all__ = [
     "tp_param_specs",
     "shard_params",
     "shard_train_state",
+    "pipeline_apply",
 ]

@@ -1,0 +1,190 @@
+"""Pipeline parallelism (parallel/pipeline.py + the staged ViT backbone).
+
+The GPipe schedule must be a pure re-ordering: pipelined forward AND
+backward match the sequential stage composition exactly (float32). The
+reference has no model parallelism (SURVEY.md §3.2) — this is TPU-native
+surface like TP/SP.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models import zoo
+from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
+from mx_rcnn_tpu.parallel.pipeline import pipeline_apply
+
+
+def _toy(rng, s=4):
+    w = jnp.asarray(rng.randn(s, 16, 16) * 0.3, jnp.float32)
+    b = jnp.asarray(rng.randn(s, 16) * 0.1, jnp.float32)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def sequential(params, x):
+        y = x
+        for i in range(s):
+            y = stage_fn(jax.tree.map(lambda a: a[i], params), y)
+        return y
+
+    return {"w": w, "b": b}, stage_fn, sequential
+
+
+def test_toy_pipeline_matches_sequential(rng):
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = create_mesh("2x4")
+    params, stage_fn, sequential = _toy(rng)
+    x = jnp.asarray(rng.randn(8, 5, 16), jnp.float32)
+    out = jax.jit(
+        lambda p, x: pipeline_apply(stage_fn, p, x, mesh, "model"))(params, x)
+    np.testing.assert_allclose(out, sequential(params, x), rtol=1e-6)
+
+
+def test_toy_pipeline_gradients_match(rng):
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = create_mesh("2x4")
+    params, stage_fn, sequential = _toy(rng)
+    x = jnp.asarray(rng.randn(8, 5, 16), jnp.float32)
+
+    g_pp = jax.jit(jax.grad(
+        lambda p: jnp.sum(pipeline_apply(stage_fn, p, x, mesh, "model") ** 2)
+    ))(params)
+    g_seq = jax.jit(jax.grad(
+        lambda p: jnp.sum(sequential(p, x) ** 2)))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        g_pp, g_seq)
+
+
+def test_more_microbatches_shrink_nothing_numerically(rng):
+    """m=8 over 4 stages (smaller bubble) is still exact."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = create_mesh("2x4")
+    params, stage_fn, sequential = _toy(rng)
+    x = jnp.asarray(rng.randn(16, 5, 16), jnp.float32)
+    out = jax.jit(lambda p, x: pipeline_apply(
+        stage_fn, p, x, mesh, "model", microbatches=8))(params, x)
+    np.testing.assert_allclose(out, sequential(params, x), rtol=1e-6)
+
+
+def test_microbatch_data_shard_mismatch_raises(rng):
+    """Microbatch size must still divide over the data axis (DP x PP)."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = create_mesh("2x4")
+    params, stage_fn, _ = _toy(rng)
+    x = jnp.asarray(rng.randn(8, 5, 16), jnp.float32)
+    with pytest.raises(ValueError, match="data axis"):
+        pipeline_apply(stage_fn, params, x, mesh, "model", microbatches=8)
+
+
+def test_indivisible_microbatch_raises(rng):
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = create_mesh("2x4")
+    params, stage_fn, _ = _toy(rng)
+    x = jnp.asarray(rng.randn(6, 5, 16), jnp.float32)
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline_apply(stage_fn, params, x, mesh, "model")
+
+
+def _vit_pp_cfg(pp_stages=2, **overrides):
+    base = {
+        "image.pad_shape": (128, 128),
+        "train.batch_images": 4,
+        "network.vit_dim": 32,
+        "network.vit_depth": 4,
+        "network.vit_heads": 2,
+        "network.vit_window": 4,
+        "network.compute_dtype": "float32",
+        "network.pp_stages": pp_stages,
+        "train.fpn_rpn_pre_nms_per_level": 64,
+        "train.rpn_post_nms_top_n": 64,
+        "train.batch_rois": 32,
+        "train.max_gt_boxes": 8,
+    }
+    base.update(overrides)
+    return generate_config("vitdet_b", "synthetic", **base)
+
+
+def _batch(rng, b=4):
+    one = {
+        "image": rng.randn(1, 128, 128, 3).astype(np.float32),
+        "im_info": np.asarray([[128, 128, 1.0]], np.float32),
+        "gt_boxes": np.asarray(
+            [[[10, 10, 60, 90], [70, 20, 120, 70]] + [[0, 0, 0, 0]] * 6],
+            np.float32),
+        "gt_classes": np.asarray([[1, 2] + [0] * 6], np.int32),
+        "gt_valid": np.asarray([[True, True] + [False] * 6]),
+    }
+    return {k: np.repeat(v, b, axis=0) for k, v in one.items()}
+
+
+def test_vitdet_pp_train_step_matches_sequential(rng):
+    """Two DP x PP train steps reproduce the single-device staged run —
+    the pipeline is a schedule, not a numerics change."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    from mx_rcnn_tpu.train.optimizer import build_optimizer
+    from mx_rcnn_tpu.train.step import create_train_state, make_train_step
+
+    cfg = _vit_pp_cfg()
+    batch = _batch(rng)
+    model_seq = zoo.build_model(cfg)  # no mesh: sequential staged backbone
+    params = zoo.init_params(model_seq, cfg, jax.random.PRNGKey(0))
+
+    def run(model, mesh):
+        tx = build_optimizer(cfg, params, steps_per_epoch=10)
+        state = create_train_state(params, tx)
+        step = make_train_step(model, cfg, mesh=mesh, donate=False,
+                               forward_fn=zoo.forward_train)
+        losses = []
+        for i in range(2):
+            b = shard_batch(batch, mesh) if mesh is not None else batch
+            state, metrics = step(state, b, jax.random.PRNGKey(7 + i))
+            losses.append(float(metrics["TotalLoss"]))
+        return losses
+
+    ref = run(model_seq, None)
+    mesh = create_mesh("2x2")
+    pp = run(zoo.build_model(cfg, mesh=mesh), mesh)
+    np.testing.assert_allclose(pp, ref, rtol=2e-4)
+
+
+def test_pp_and_tp_are_mutually_exclusive():
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    cfg = _vit_pp_cfg(**{"network.tensor_parallel": True})
+    with pytest.raises(ValueError, match="model' axis"):
+        zoo.build_model(cfg, mesh=create_mesh("2x2"))
+
+
+def test_pp_and_sp_are_mutually_exclusive():
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    cfg = _vit_pp_cfg(**{"network.use_ring_attention": True})
+    with pytest.raises(ValueError, match="model' axis"):
+        zoo.build_model(cfg, mesh=create_mesh("2x2"))
+
+
+def test_pp_mesh_size_mismatch_raises():
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    cfg = _vit_pp_cfg(pp_stages=4)
+    with pytest.raises(ValueError, match="pp_stages"):
+        zoo.build_model(cfg, mesh=create_mesh("4x2"))
+
+
+def test_pp_depth_not_divisible_raises():
+    cfg = _vit_pp_cfg(pp_stages=3)
+    with pytest.raises(ValueError, match="divide"):
+        zoo.build_model(cfg).init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 64, 64, 3), jnp.float32),
+            jnp.asarray([[0.0, 0, 0, 31, 31]], jnp.float32))
