@@ -86,6 +86,10 @@ class NetworkConfig:
     # pp_microbatches=0 → one microbatch per stage.
     pp_stages: int = 0
     pp_microbatches: int = 0
+    # Proposal pre-NMS top-k: "exact" (lax.top_k) or "approx"
+    # (lax.approx_max_k, recall 0.95 — the TPU PartialReduce op; ~1.2 ms
+    # off the FPN step, exact kept default for determinism. PERF.md).
+    proposal_topk: str = "exact"
     # DETR (stretch config; models/detr.py).
     use_detr: bool = False
     detr_queries: int = 100

@@ -237,6 +237,7 @@ def forward_train(
         nms_thresh=cfg.train.rpn_nms_thresh,
         min_size=cfg.train.rpn_min_size,
         feat_stride=stride,
+        topk_impl=cfg.network.proposal_topk,
     )
 
     # --- ROI sampling (reference: ProposalTarget op — host numpy there) ---
@@ -317,6 +318,7 @@ def forward_test(
         nms_thresh=cfg.test.rpn_nms_thresh,
         min_size=cfg.test.rpn_min_size,
         feat_stride=stride,
+        topk_impl=cfg.network.proposal_topk,
     )
     b, r = rois.shape[0], rois.shape[1]
     pooled = _pool_rois(feat, rois, roi_valid,
@@ -456,6 +458,7 @@ def forward_rpn(
         nms_thresh=cfg.test.proposal_nms_thresh,
         min_size=cfg.test.rpn_min_size,
         feat_stride=cfg.network.rpn_feat_stride,
+        topk_impl=cfg.network.proposal_topk,
     )
 
 

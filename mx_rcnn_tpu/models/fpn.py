@@ -263,7 +263,8 @@ def fpn_proposals(
         k = min(per_level, scores.shape[1])
         tb, ts, tv = jax.vmap(
             partial(_decode_one_image, pre_nms_top_n=k,
-                    min_size=tc.rpn_min_size),
+                    min_size=tc.rpn_min_size,
+                    topk_impl=cfg.network.proposal_topk),
             in_axes=(0, 0, 0, None),
         )(scores, dl, im_info, jnp.asarray(anchors[lv]))
         boxes_all.append(tb)

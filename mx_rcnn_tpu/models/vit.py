@@ -109,6 +109,27 @@ class Block(nn.Module):
         return x + y
 
 
+def _embed_patches(mdl, x: jnp.ndarray) -> jnp.ndarray:
+    """Shared embed surface: patch Conv + bilinearly-resized absolute
+    pos-embed. Called from the compact bodies of BOTH backbones (same
+    param names — `patch_embed`, `pos_embed` — so the checkpoint format is
+    identical; static under jit: shapes are compile-time)."""
+    x = nn.Conv(mdl.dim, (mdl.patch, mdl.patch),
+                strides=(mdl.patch, mdl.patch), dtype=mdl.dtype,
+                param_dtype=jnp.float32, name="patch_embed")(
+                    x.astype(mdl.dtype))
+    h, w = x.shape[1], x.shape[2]
+    pos = mdl.param("pos_embed", nn.initializers.normal(0.02),
+                    (1, mdl.pos_grid, mdl.pos_grid, mdl.dim), jnp.float32)
+    pos = jax.image.resize(pos, (1, h, w, mdl.dim), "bilinear")
+    return x + pos.astype(mdl.dtype)
+
+
+def _final_norm(mdl, x: jnp.ndarray) -> jnp.ndarray:
+    return nn.LayerNorm(dtype=mdl.dtype, param_dtype=jnp.float32,
+                        name="norm")(x)
+
+
 class ViTBackbone(nn.Module):
     """Plain ViT encoder → single stride-16 feature map (B, H/16, W/16, C).
 
@@ -129,17 +150,7 @@ class ViTBackbone(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, attn_fn=None) -> jnp.ndarray:
-        b = x.shape[0]
-        x = nn.Conv(self.dim, (self.patch, self.patch),
-                    strides=(self.patch, self.patch), dtype=self.dtype,
-                    param_dtype=jnp.float32, name="patch_embed")(
-                        x.astype(self.dtype))
-        h, w = x.shape[1], x.shape[2]
-        pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (1, self.pos_grid, self.pos_grid, self.dim),
-                         jnp.float32)
-        pos = jax.image.resize(pos, (1, h, w, self.dim), "bilinear")
-        x = x + pos.astype(self.dtype)
+        x = _embed_patches(self, x)
         # ViTDet: split the depth into 4 subsets, each ENDING with a global
         # block (ViT-B depth 12 → globals at 2, 5, 8, 11); degenerate small
         # depths (< 4) make every block global.
@@ -151,9 +162,7 @@ class ViTBackbone(nn.Module):
                       window=0 if is_global else self.window,
                       dtype=self.dtype, name=f"block{i}")(
                           x, attn_fn if is_global else None)
-        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
-                         name="norm")(x)
-        return x
+        return _final_norm(self, x)
 
 
 class ViTStage(nn.Module):
@@ -205,17 +214,7 @@ class ViTBackbonePP(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, pipeline_fn=None) -> jnp.ndarray:
-        x = nn.Conv(self.dim, (self.patch, self.patch),
-                    strides=(self.patch, self.patch), dtype=self.dtype,
-                    param_dtype=jnp.float32, name="patch_embed")(
-                        x.astype(self.dtype))
-        h, w = x.shape[1], x.shape[2]
-        pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (1, self.pos_grid, self.pos_grid, self.dim),
-                         jnp.float32)
-        pos = jax.image.resize(pos, (1, h, w, self.dim), "bilinear")
-        x = x + pos.astype(self.dtype)
-
+        x = _embed_patches(self, x)
         stage_kw = dict(dim=self.dim, heads=self.heads, window=self.window,
                         blocks=self.blocks_per_stage, dtype=self.dtype)
         ScanStages = nn.scan(
@@ -235,8 +234,7 @@ class ViTBackbonePP(nn.Module):
                 return y
 
             x = pipeline_fn(stage_fn, stacked, x)
-        return nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
-                            name="norm")(x)
+        return _final_norm(self, x)
 
 
 class SimpleFeaturePyramid(nn.Module):

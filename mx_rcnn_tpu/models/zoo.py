@@ -52,6 +52,14 @@ def build_model(cfg: Config, mesh=None):
             "have a token sequence to shard",
             cfg.network.use_ring_attention, cfg.network.sp_mode,
             cfg.network.name)
+    if cfg.network.pp_stages and not cfg.network.use_vit:
+        from mx_rcnn_tpu.logger import logger
+
+        logger.warning(
+            "network.pp_stages=%d has no effect on %s: only the ViT "
+            "encoder has the homogeneous staged structure to pipeline "
+            "(parallel/pipeline.py)",
+            cfg.network.pp_stages, cfg.network.name)
     if cfg.network.use_detr:
         from mx_rcnn_tpu.models import detr as _detr
 

@@ -56,6 +56,7 @@ def generate_proposals(
     min_size: float,
     feat_stride: int = 16,
     nms_impl: str = "auto",
+    topk_impl: str = "exact",
 ):
     """Batched proposal generation.
 
@@ -70,6 +71,9 @@ def generate_proposals(
       min_size: min box side at the ORIGINAL scale; scaled by im_scale as in
         the reference (proposal.py: min_size * im_info[2]).
       nms_impl: "auto" | "pallas" | "xla" (see module docstring).
+      topk_impl: "exact" (lax.top_k) | "approx" (lax.approx_max_k,
+        recall_target 0.95 — the TPU PartialReduce op; ~1.2 ms faster at
+        the 245k-score C4 size, identical on backends without the op).
 
     Returns:
       rois: (B, post_nms_top_n, 4) image-coordinate boxes,
@@ -88,7 +92,8 @@ def generate_proposals(
 
     k = min(pre_nms_top_n, scores.shape[1])
     top_boxes, top_scores, top_valid = jax.vmap(
-        partial(_decode_one_image, pre_nms_top_n=k, min_size=min_size),
+        partial(_decode_one_image, pre_nms_top_n=k, min_size=min_size,
+                topk_impl=topk_impl),
         in_axes=(0, 0, 0, None),
     )(scores, deltas, im_info, anchors)
 
@@ -105,7 +110,8 @@ def generate_proposals(
     return rois, keep_valid, roi_scores
 
 
-def _decode_one_image(scores, deltas, im_info, anchors, *, pre_nms_top_n, min_size):
+def _decode_one_image(scores, deltas, im_info, anchors, *, pre_nms_top_n,
+                      min_size, topk_impl: str = "exact"):
     """Per-image decode: deltas → boxes → clip → min-size mask → top-k."""
     boxes = bbox_pred(anchors, deltas)  # (N, 4)
     boxes = clip_boxes(boxes, (im_info[0], im_info[1]))
@@ -115,8 +121,17 @@ def _decode_one_image(scores, deltas, im_info, anchors, *, pre_nms_top_n, min_si
     min_sz = min_size * im_info[2]
     size_ok = (ws >= min_sz) & (hs >= min_sz)
     scores = jnp.where(size_ok, scores, -1e10)
-    # top-k pre-NMS trim.
-    top_scores, top_idx = lax.top_k(scores, pre_nms_top_n)
+    # top-k pre-NMS trim. "approx" keeps score ORDER within the returned
+    # set (approx_max_k returns sorted results; only membership at the
+    # tail is approximate), so downstream NMS semantics are unchanged.
+    if topk_impl == "approx":
+        top_scores, top_idx = lax.approx_max_k(
+            scores, pre_nms_top_n, recall_target=0.95)
+    elif topk_impl == "exact":
+        top_scores, top_idx = lax.top_k(scores, pre_nms_top_n)
+    else:
+        raise ValueError(
+            f"topk_impl must be 'exact' or 'approx', got {topk_impl!r}")
     top_boxes = boxes[top_idx]
     top_valid = top_scores > -1e9
     return top_boxes, top_scores, top_valid

@@ -70,6 +70,14 @@ def pipeline_apply(
       over `batch_axis`).
     """
     s = mesh.shape[axis]
+    bad = [tuple(leaf.shape) for leaf in jax.tree.leaves(stage_params)
+           if leaf.shape[:1] != (s,)]
+    if bad:
+        # A larger multiple would pass shard_map's divisibility check and
+        # silently compose only every (S/s)-th stage — hard error instead.
+        raise ValueError(
+            f"stage_params leading axis must equal the {s}-way '{axis}' "
+            f"mesh axis; got leaf shapes {bad[:3]}")
     m = microbatches or s
     if x.shape[0] % m:
         raise ValueError(

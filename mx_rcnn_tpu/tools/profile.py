@@ -122,7 +122,8 @@ def main(argv=None):
                 pre_nms_top_n=cfg.train.rpn_pre_nms_top_n,
                 post_nms_top_n=cfg.train.rpn_post_nms_top_n,
                 nms_thresh=cfg.train.rpn_nms_thresh,
-                min_size=cfg.train.rpn_min_size)
+                min_size=cfg.train.rpn_min_size,
+                topk_impl=cfg.network.proposal_topk)
             return jnp.sum(rois), jnp.sum(rv)
         _timeit("+proposals (topk+nms)", jax.jit(with_proposals), params,
                 batch, rng, iters=args.iters)

@@ -218,3 +218,26 @@ def test_generate_proposals_pallas_vs_xla(rng):
     np.testing.assert_allclose(r1[0], r2[0], rtol=1e-6)
     assert np.array_equal(r1[1], r2[1])
     np.testing.assert_allclose(r1[2], r2[2], rtol=1e-6)
+
+
+def test_generate_proposals_approx_topk(rng):
+    """network.proposal_topk="approx" (lax.approx_max_k): same contract,
+    and at sizes where the reduction is exact, identical results."""
+    from mx_rcnn_tpu.ops.anchors import anchor_grid
+    from mx_rcnn_tpu.ops.proposal import generate_proposals
+
+    h, w, a = 8, 8, 9
+    anchors = jnp.asarray(anchor_grid(h, w, stride=16))
+    prob = jnp.asarray(rng.rand(2, h, w, 2 * a).astype(np.float32))
+    deltas = jnp.asarray((rng.randn(2, h, w, 4 * a) * 0.1).astype(np.float32))
+    im_info = jnp.asarray([[120.0, 120.0, 1.0], [100.0, 110.0, 1.0]])
+    kw = dict(pre_nms_top_n=200, post_nms_top_n=50, nms_thresh=0.7, min_size=4)
+    ex = generate_proposals(prob, deltas, im_info, anchors,
+                            topk_impl="exact", **kw)
+    ap = generate_proposals(prob, deltas, im_info, anchors,
+                            topk_impl="approx", **kw)
+    np.testing.assert_allclose(ap[0], ex[0], rtol=1e-6)
+    assert np.array_equal(ap[1], ex[1])
+    with pytest.raises(ValueError, match="topk_impl"):
+        generate_proposals(prob, deltas, im_info, anchors,
+                           topk_impl="bogus", **kw)

@@ -84,6 +84,18 @@ def test_microbatch_data_shard_mismatch_raises(rng):
         pipeline_apply(stage_fn, params, x, mesh, "model", microbatches=8)
 
 
+def test_stage_axis_mesh_mismatch_raises(rng):
+    """S=8 stacked stages over a 4-way axis would silently compose only
+    every other stage via shard_map slicing — must hard-error."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = create_mesh("2x4")
+    params, stage_fn, _ = _toy(rng, s=8)
+    x = jnp.asarray(rng.randn(8, 5, 16), jnp.float32)
+    with pytest.raises(ValueError, match="stage_params leading axis"):
+        pipeline_apply(stage_fn, params, x, mesh, "model")
+
+
 def test_indivisible_microbatch_raises(rng):
     if jax.device_count() < 8:
         pytest.skip("needs 8 devices")
