@@ -236,8 +236,19 @@ def test_generate_proposals_approx_topk(rng):
                             topk_impl="exact", **kw)
     ap = generate_proposals(prob, deltas, im_info, anchors,
                             topk_impl="approx", **kw)
-    np.testing.assert_allclose(ap[0], ex[0], rtol=1e-6)
-    assert np.array_equal(ap[1], ex[1])
+    if jax.default_backend() == "cpu":
+        # On CPU the approximate reduction degenerates to exact; on real
+        # TPU recall_target=0.95 only bounds tail MEMBERSHIP, so equality
+        # would flake there — assert the full contract only where exact.
+        np.testing.assert_allclose(ap[0], ex[0], rtol=1e-6)
+        assert np.array_equal(ap[1], ex[1])
+        np.testing.assert_allclose(ap[2], ex[2], rtol=1e-6)
+    else:
+        # Recall bound: ≥90% of the exact kept rois appear in the approx
+        # set (50 kept from 200 candidates; tail misses only).
+        kept_ex = {tuple(np.round(r, 3)) for r in np.asarray(ex[0][0])[np.asarray(ex[1][0])]}
+        kept_ap = {tuple(np.round(r, 3)) for r in np.asarray(ap[0][0])[np.asarray(ap[1][0])]}
+        assert len(kept_ex & kept_ap) >= 0.9 * len(kept_ex)
     with pytest.raises(ValueError, match="topk_impl"):
         generate_proposals(prob, deltas, im_info, anchors,
                            topk_impl="bogus", **kw)

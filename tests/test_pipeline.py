@@ -200,3 +200,28 @@ def test_pp_depth_not_divisible_raises():
             jax.random.PRNGKey(0),
             jnp.zeros((1, 64, 64, 3), jnp.float32),
             jnp.asarray([[0.0, 0, 0, 31, 31]], jnp.float32))
+
+def test_fit_detector_pp_smoke(tmp_path, rng):
+    """The full train loop with the pipelined staged encoder on a 2x2
+    mesh (DP x PP) — covers loader batch shapes, microbatch divisibility,
+    and checkpointing of the stacked stage params."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.tools.train import fit_detector
+
+    cfg = _vit_pp_cfg(**{
+        "image.scales": ((128, 128),),
+        "train.batch_images": 2,  # global 4 → 2 microbatches × 2 data shards
+        "train.flip": False,
+        "train.lr_step": (100,),
+    })
+    ds = SyntheticDataset("train", num_images=8, image_size=128,
+                          max_objects=2, min_size_frac=4, max_size_frac=2)
+    history = []
+    fit_detector(cfg, ds.gt_roidb(), prefix=str(tmp_path / "pp"),
+                 end_epoch=1, frequent=1000, seed=0, mesh_spec="2x2",
+                 epoch_callback=lambda e, s, b: history.append(
+                     b.get()["TotalLoss"]))
+    assert len(history) == 1 and np.isfinite(history).all(), history
+    assert (tmp_path / "pp" / "0001").exists()
