@@ -109,6 +109,15 @@ class Block(nn.Module):
         return x + y
 
 
+def _global_block_indices(depth: int) -> set:
+    """ViTDet global-attention placement: the depth is split into 4
+    subsets, each ENDING with a global block (ViT-B depth 12 → {2, 5, 8,
+    11}); degenerate small depths (< 4) make every block global. Shared
+    by ViTBackbone and the staged-layout checkpoint converters."""
+    blocks = {depth * k // 4 - 1 for k in range(1, 5)}
+    return {i for i in blocks if i >= 0} or {depth - 1}
+
+
 def _embed_patches(mdl, x: jnp.ndarray) -> jnp.ndarray:
     """Shared embed surface: patch Conv + bilinearly-resized absolute
     pos-embed. Called from the compact bodies of BOTH backbones (same
@@ -151,11 +160,7 @@ class ViTBackbone(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, attn_fn=None) -> jnp.ndarray:
         x = _embed_patches(self, x)
-        # ViTDet: split the depth into 4 subsets, each ENDING with a global
-        # block (ViT-B depth 12 → globals at 2, 5, 8, 11); degenerate small
-        # depths (< 4) make every block global.
-        global_blocks = {self.depth * k // 4 - 1 for k in range(1, 5)}
-        global_blocks = {i for i in global_blocks if i >= 0} or {self.depth - 1}
+        global_blocks = _global_block_indices(self.depth)
         for i in range(self.depth):
             is_global = i in global_blocks
             x = Block(self.dim, self.heads,
@@ -411,6 +416,84 @@ def build_vitdet_model(cfg: Config, global_attn_fn=None,
         pp_stages=pp_stages,
         pipeline_fn=pipeline_fn,
     )
+
+
+def sequential_to_staged(params, stages_n: int):
+    """Convert a ViTDet param tree from the sequential backbone layout
+    (`features/block{i}` with globals at depth/4 tails) to the staged/PP
+    layout (`features/stages` with leaves stacked on a leading stage axis).
+
+    Enables the train-small → scale-out path: fit with the default
+    backbone on one chip, then resume/continue under pp_stages. Only valid
+    when the architectures coincide — stages_n == 4 (or depth < 4), since
+    each ViTStage ends with its global block (see build_vitdet_model
+    warning). Non-backbone leaves pass through unchanged.
+    """
+    feats = params["params"]["features"]
+    blocks = sorted((k for k in feats if k.startswith("block")),
+                    key=lambda k: int(k[5:]))
+    depth = len(blocks)
+    if not depth:
+        raise ValueError(
+            "no features/block* leaves — not a sequential-backbone param "
+            "tree (already staged?)")
+    if depth % stages_n:
+        raise ValueError(f"depth {depth} must divide into {stages_n} stages")
+    per = depth // stages_n
+    stage_tails = {(s + 1) * per - 1 for s in range(stages_n)}
+    if stage_tails != _global_block_indices(depth):
+        raise ValueError(
+            f"sequential globals at {sorted(_global_block_indices(depth))} "
+            f"don't match the stage tails {sorted(stage_tails)} of a "
+            f"{stages_n}-stage layout; the architectures differ "
+            "(use stages_n=4)")
+
+    # ViTStage names its blocks win0..win{per-2}, glob.
+    def stage_tree(s):
+        names = [f"win{i}" for i in range(per - 1)] + ["glob"]
+        return {name: feats[blocks[s * per + j]]
+                for j, name in enumerate(names)}
+
+    stages = jax.tree.map(lambda *leaves: jnp.stack(leaves),
+                          *[stage_tree(s) for s in range(stages_n)])
+    new_feats = {k: v for k, v in feats.items() if not k.startswith("block")}
+    new_feats["stages"] = stages
+    return {**params, "params": {**params["params"], "features": new_feats}}
+
+
+def staged_to_sequential(params):
+    """Inverse of sequential_to_staged (stacked stages → block{i}).
+
+    Validates the same architecture constraint as the forward direction:
+    a staged layout whose stage tails don't coincide with the sequential
+    backbone's global placement (pp_stages != 4) would convert into
+    params that LOAD cleanly (Block shapes are window-independent) but
+    run the wrong attention pattern — rejected instead.
+    """
+    feats = params["params"]["features"]
+    if "stages" not in feats:
+        raise ValueError(
+            "no features/stages subtree — not a staged-backbone param tree")
+    stages = feats["stages"]
+    stages_n = jax.tree.leaves(stages)[0].shape[0]
+    names = sorted((k for k in stages if k.startswith("win")),
+                   key=lambda k: int(k[3:])) + ["glob"]
+    per = len(names)
+    depth = stages_n * per
+    stage_tails = {(s + 1) * per - 1 for s in range(stages_n)}
+    if stage_tails != _global_block_indices(depth):
+        raise ValueError(
+            f"staged layout has global blocks at stage tails "
+            f"{sorted(stage_tails)} but the sequential backbone at depth "
+            f"{depth} places them at "
+            f"{sorted(_global_block_indices(depth))}; the architectures "
+            "differ (only stages_n=4 checkpoints convert)")
+    new_feats = {k: v for k, v in feats.items() if k != "stages"}
+    for s in range(stages_n):
+        for j, name in enumerate(names):
+            new_feats[f"block{s * per + j}"] = jax.tree.map(
+                lambda a: a[s], stages[name])
+    return {**params, "params": {**params["params"], "features": new_feats}}
 
 
 def init_vitdet_params(model: ViTDet, cfg: Config, rng: jax.Array,

@@ -159,9 +159,7 @@ def test_vitdet_tp_step_matches_replicated(rng):
                                    rtol=1e-3, atol=1e-5, err_msg=name)
 
 
-def test_detr_tp_step_matches_replicated(rng):
-    if jax.device_count() < 4:
-        pytest.skip("needs 4 devices")
+def _detr_tp_cfg(**overrides):
     base = {
         "image.pad_shape": (128, 128),
         "train.batch_images": 2,
@@ -176,7 +174,14 @@ def test_detr_tp_step_matches_replicated(rng):
         "network.tensor_parallel": True,
         "train.max_gt_boxes": 8,
     }
-    cfg = generate_config("detr_r50", "synthetic", **base)
+    base.update(overrides)
+    return generate_config("detr_r50", "synthetic", **base)
+
+
+def test_detr_tp_step_matches_replicated(rng):
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    cfg = _detr_tp_cfg()
     model = zoo.build_model(cfg)
     params = zoo.init_params(model, cfg, jax.random.PRNGKey(0))
     specs = _flat(tp_param_specs(params))
@@ -255,18 +260,9 @@ def test_fit_detector_tp_smoke(tmp_path, rng):
     from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
     from mx_rcnn_tpu.tools.train import fit_detector
 
-    cfg = generate_config("detr_r50", "synthetic", **{
-        "image.pad_shape": (128, 128),
+    cfg = _detr_tp_cfg(**{
         "image.scales": ((128, 128),),
-        "network.detr_queries": 20,
-        "network.detr_hidden": 64,
-        "network.detr_heads": 4,
-        "network.detr_enc_layers": 2,
-        "network.detr_dec_layers": 2,
-        "network.norm": "group",
-        "network.freeze_at": 0,
-        "network.tensor_parallel": True,
-        "train.max_gt_boxes": 8,
+        "network.compute_dtype": "bfloat16",  # the production dtype path
         "train.batch_images": 1,
         "train.flip": False,
         "train.lr_step": (100,),
