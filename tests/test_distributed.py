@@ -73,11 +73,12 @@ print(f"RESULT rank={rank} loss={loss:.8f} checksum={ck:.6f}", flush=True)
 """
 
 
-@pytest.mark.slow
-def test_two_process_dp(tmp_path):
+def _run_two_process_workers(worker_src: str, tmp_path) -> dict:
+    """Spawn 2 coordinated worker processes (MXRCNN_* env contract), wait,
+    and return {rank: {key: value-string}} parsed from each RESULT line."""
     port = _free_port()
     script = tmp_path / "worker.py"
-    script.write_text(WORKER)
+    script.write_text(worker_src)
     procs = []
     for rank in range(2):
         env = dict(os.environ)
@@ -106,8 +107,14 @@ def test_two_process_dp(tmp_path):
         assert p.returncode == 0, out[-3000:]
         line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][0]
         kv = dict(part.split("=") for part in line.split()[1:])
-        results[int(kv["rank"])] = (float(kv["loss"]), float(kv["checksum"]))
+        results[int(kv.pop("rank"))] = kv
     assert set(results) == {0, 1}
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_dp(tmp_path):
+    results = _run_two_process_workers(WORKER, tmp_path)
     # Replicated state: both processes computed the SAME loss and params.
     assert results[0] == results[1], results
 
@@ -118,3 +125,83 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+TP_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from mx_rcnn_tpu.parallel.distributed import maybe_initialize_distributed
+maybe_initialize_distributed()
+
+import jax, numpy as np
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models import zoo
+from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
+from mx_rcnn_tpu.parallel.partition import shard_train_state, tp_param_specs
+from mx_rcnn_tpu.train.optimizer import build_optimizer
+from mx_rcnn_tpu.train.step import create_train_state, make_train_step
+
+cfg = generate_config("detr_r50", "synthetic", **{
+    "image.pad_shape": (64, 64),
+    "network.detr_queries": 10,
+    "network.detr_hidden": 32,
+    "network.detr_heads": 2,
+    "network.detr_enc_layers": 1,
+    "network.detr_dec_layers": 1,
+    "network.norm": "group",
+    "network.freeze_at": 0,
+    "network.compute_dtype": "float32",
+    "network.tensor_parallel": True,
+    "train.max_gt_boxes": 4,
+    "train.batch_images": 1,
+})
+model = zoo.build_model(cfg)
+params = zoo.init_params(model, cfg, jax.random.PRNGKey(0))
+tx = build_optimizer(cfg, params, steps_per_epoch=10)
+state = create_train_state(params, tx)
+# (data=4, model=2): the DP gradient psum crosses the process boundary,
+# the Megatron TP collectives stay intra-process (the ICI-like axis).
+mesh = create_mesh("4x2")
+specs = tp_param_specs(state.params)
+state = shard_train_state(state, mesh, specs)
+step = make_train_step(model, cfg, mesh=mesh, donate=False,
+                       forward_fn=zoo.forward_train, param_specs=specs)
+
+rank = jax.process_index()
+rs = np.random.RandomState(0)
+g_img = rs.randn(4, 64, 64, 3).astype(np.float32)
+gt = np.zeros((4, 4, 4), np.float32); gt[:, 0] = [8, 8, 40, 40]
+valid = np.zeros((4, 4), bool); valid[:, 0] = True
+cls = np.zeros((4, 4), np.int32); cls[:, 0] = 1
+local = slice(rank * 2, rank * 2 + 2)
+batch = {
+    "image": g_img[local],
+    "im_info": np.asarray([[64, 64, 1.0]] * 2, np.float32),
+    "gt_boxes": gt[local], "gt_classes": cls[local],
+    "gt_valid": valid[local],
+}
+state, metrics = step(state, shard_batch(batch, mesh), jax.random.PRNGKey(7))
+loss = float(metrics["TotalLoss"])
+ck = float(sum(jax.numpy.sum(jax.numpy.abs(l)).astype(jax.numpy.float64)
+               for l in jax.tree.leaves(state.params)))
+n_sharded = sum(1 for l in jax.tree.leaves(state.params)
+                if not l.sharding.is_fully_replicated)
+print(f"RESULT rank={rank} loss={loss:.8f} checksum={ck:.6f} "
+      f"sharded={n_sharded}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_dp_tp(tmp_path):
+    """DP x TP across a process boundary: 2 processes x 4 devices form a
+    (4, 2) mesh; Megatron-sharded DETR weights, gradient psum spanning
+    the processes. Both ranks must agree bit-for-bit."""
+    results = _run_two_process_workers(TP_WORKER, tmp_path)
+    assert results[0] == results[1], results
+    assert int(results[0]["sharded"]) > 0, "no TP-sharded leaves"

@@ -230,8 +230,6 @@ def test_fit_detector_pp_smoke(tmp_path, rng):
 def test_sequential_to_staged_checkpoint_conversion(rng):
     """A sequentially-trained ViTDet param tree converts to the staged/PP
     layout with identical numerics (and back, bit-exact round trip)."""
-    from dataclasses import replace
-
     from mx_rcnn_tpu.models.vit import (
         sequential_to_staged, staged_to_sequential)
 
