@@ -144,6 +144,10 @@ class TrainConfig:
     optimizer: str = "sgd"
     begin_epoch: int = 0
     end_epoch: int = 10
+    # Non-blocking epoch-end saves (orbax AsyncCheckpointer — the train
+    # loop keeps stepping while the write lands; train/checkpoint.py
+    # CheckpointWriter). Auto-falls back to synchronous saves multi-host.
+    async_checkpoint: bool = True
     # Data
     batch_images: int = 1  # images per device
     shuffle: bool = True

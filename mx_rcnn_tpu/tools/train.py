@@ -72,6 +72,11 @@ def fit_detector(
     iterator (AnchorLoader default, ROIIter for Fast R-CNN);
     fixed_param_patterns extends the frozen set (alternate stages 4/6 freeze
     the shared conv trunk — reference train_alternate.py).
+
+    With train.async_checkpoint (default, single-process) the epoch-end
+    save is enqueued, not durable, when epoch_callback runs — a callback
+    that READS the just-saved checkpoint from disk must not assume it has
+    landed (it is durable by the next epoch's save and before return).
     """
     from mx_rcnn_tpu.parallel.distributed import is_primary, local_data_shards
 
@@ -174,23 +179,36 @@ def fit_detector(
     batch_size = cfg.train.batch_images * n_data
     speedometer = Speedometer(batch_size, frequent)
 
-    for epoch in range(begin_epoch, end_epoch):
-        bag = MetricBag()
-        for i, batch in enumerate(loader):
-            rng, k = jax.random.split(rng)
-            state, metrics = step_fn(state, shard_batch(batch, mesh), k)
-            bag.update(metrics)
-            speedometer(epoch, i, bag)
-        logger.info("Epoch[%d] done. %s", epoch, bag.format())
-        # checkpoint_period > 1 (long small-epoch runs, e.g. the DETR
-        # gate's 150 epochs): save every Nth epoch and always the last —
-        # resume granularity traded against orbax save time.
-        if is_primary() and ((epoch + 1) % max(1, checkpoint_period) == 0
-                             or epoch + 1 == end_epoch):
-            save_checkpoint(
-                prefix, epoch + 1, state.params, state.opt_state,
-                means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
-                num_classes=cfg.dataset.num_classes)
-        if epoch_callback:
-            epoch_callback(epoch, state, bag)
+    # Async epoch-end saves (train/checkpoint.py CheckpointWriter); the
+    # multi-host primary-only pattern needs the synchronous path (orbax's
+    # cross-process commit barrier would hang with one caller).
+    writer = None
+    if cfg.train.async_checkpoint and jax.process_count() == 1:
+        from mx_rcnn_tpu.train.checkpoint import CheckpointWriter
+
+        writer = CheckpointWriter()
+
+    try:
+        for epoch in range(begin_epoch, end_epoch):
+            bag = MetricBag()
+            for i, batch in enumerate(loader):
+                rng, k = jax.random.split(rng)
+                state, metrics = step_fn(state, shard_batch(batch, mesh), k)
+                bag.update(metrics)
+                speedometer(epoch, i, bag)
+            logger.info("Epoch[%d] done. %s", epoch, bag.format())
+            # checkpoint_period > 1 (long small-epoch runs, e.g. the DETR
+            # gate's 150 epochs): save every Nth epoch and always the last —
+            # resume granularity traded against orbax save time.
+            if is_primary() and ((epoch + 1) % max(1, checkpoint_period) == 0
+                                 or epoch + 1 == end_epoch):
+                save = writer.save if writer is not None else save_checkpoint
+                save(prefix, epoch + 1, state.params, state.opt_state,
+                     means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+                     num_classes=cfg.dataset.num_classes)
+            if epoch_callback:
+                epoch_callback(epoch, state, bag)
+    finally:
+        if writer is not None:
+            writer.close()  # the last save must be durable before return
     return jax.device_get(state.params)

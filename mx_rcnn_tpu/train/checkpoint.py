@@ -67,6 +67,20 @@ def renormalize_bbox_params(params, means: Sequence[float], stds: Sequence[float
     )
 
 
+def _prepare_save(prefix, epoch, params, opt_state, means, stds, num_classes):
+    """The ONE encoding of the on-disk form (shared by sync and async
+    paths): host (numpy) arrays — so checkpoints restore on any device
+    topology, TP/PP-sharded or not — with bbox_pred folded to raw deltas."""
+    path = os.path.abspath(os.path.join(prefix, f"{epoch:04d}"))
+    to_save = {"params": jax.device_get(params)}
+    if num_classes is not None:
+        to_save["params"] = unnormalize_bbox_params(
+            to_save["params"], means, stds, num_classes)
+    if opt_state is not None:
+        to_save["opt_state"] = jax.device_get(opt_state)
+    return path, to_save
+
+
 def save_checkpoint(prefix: str, epoch: int, params, opt_state=None, *,
                     means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2),
                     num_classes: Optional[int] = None):
@@ -75,17 +89,51 @@ def save_checkpoint(prefix: str, epoch: int, params, opt_state=None, *,
     opt_state is saved alongside when given (the reference cannot resume
     optimizer momentum — we can; --resume uses it when present).
     """
-    path = os.path.abspath(os.path.join(prefix, f"{epoch:04d}"))
-    to_save = {"params": jax.device_get(params)}
-    if num_classes is not None:
-        to_save["params"] = unnormalize_bbox_params(
-            to_save["params"], means, stds, num_classes)
-    if opt_state is not None:
-        to_save["opt_state"] = jax.device_get(opt_state)
+    path, to_save = _prepare_save(prefix, epoch, params, opt_state,
+                                  means, stds, num_classes)
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, to_save, force=True)
     logger.info("Saved checkpoint to %s", path)
     return path
+
+
+class CheckpointWriter:
+    """Async epoch checkpointing (orbax AsyncCheckpointer).
+
+    The reference blocks training while `do_checkpoint` writes `.params`;
+    here the epoch-end save is enqueued and the train loop keeps stepping
+    — the array snapshot is taken up front (device→host copy inside
+    orbax), the disk write runs in a background thread, and the PREVIOUS
+    save is awaited before the next one starts (and at close()).
+
+    Single-process use only: the primary-only save pattern of the
+    multi-host path cannot satisfy orbax's cross-process commit barrier,
+    so fit_detector falls back to the synchronous `save_checkpoint` when
+    `jax.process_count() > 1`.
+    """
+
+    def __init__(self):
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+
+    def save(self, prefix: str, epoch: int, params, opt_state=None, *,
+             means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2),
+             num_classes: Optional[int] = None):
+        """Non-blocking analog of `save_checkpoint` — _prepare_save gives
+        the identical on-disk form (host numpy; restores on any device
+        topology); only the write is backgrounded. NOT durable on return:
+        readers of the checkpoint (e.g. an eval driver watching the
+        prefix) see it after the NEXT save or close()."""
+        self._ckptr.wait_until_finished()
+        path, to_save = _prepare_save(prefix, epoch, params, opt_state,
+                                      means, stds, num_classes)
+        self._ckptr.save(path, to_save, force=True)
+        logger.info("Saving checkpoint to %s (async)", path)
+        return path
+
+    def close(self):
+        """Release the background machinery (waits for the in-flight
+        save first — orbax close() is wait + teardown)."""
+        self._ckptr.close()
 
 
 def load_checkpoint(prefix: str, epoch: int, *, template=None,
