@@ -148,6 +148,14 @@ class TrainConfig:
     # loop keeps stepping while the write lands; train/checkpoint.py
     # CheckpointWriter). Auto-falls back to synchronous saves multi-host.
     async_checkpoint: bool = True
+    # Gradient accumulation: each optimizer step averages grads over this
+    # many sequential micro-steps (unrolled inside the jitted step —
+    # compile time and HLO size grow with the count; see train/step.py
+    # for why not lax.scan), so effective batch = batch_images x
+    # grad_accum_steps x data-axis size without the activation memory of
+    # the big batch. The reference has no equivalent (SURVEY.md §3.2).
+    # 1 = off.
+    grad_accum_steps: int = 1
     # Data
     batch_images: int = 1  # images per device
     shuffle: bool = True

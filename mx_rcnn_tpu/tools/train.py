@@ -105,8 +105,19 @@ def fit_detector(
         # heads keep the fresh init above.
         from mx_rcnn_tpu.utils.pretrained import import_pretrained
         params, _ = import_pretrained(pretrained_npz, params)
+    # Gradient accumulation: the step consumes accum x batch_images images
+    # per optimizer step (train/step.py micro-step scan), so the LOADER
+    # yields that much; the model/step cfg keeps the per-micro-step size.
+    accum = max(1, cfg.train.grad_accum_steps)
+    loader_cfg = cfg
+    if accum > 1:
+        from dataclasses import replace as _replace
+        loader_cfg = cfg.with_updates(train=_replace(
+            cfg.train, batch_images=cfg.train.batch_images * accum))
+
     if loader_factory is None:
-        loader = AnchorLoader(roidb, cfg, num_shards=n_local, seed=seed,
+        loader = AnchorLoader(roidb, loader_cfg, num_shards=n_local,
+                              seed=seed,
                               process_count=jax.process_count(),
                               process_index=jax.process_index())
     else:
@@ -116,7 +127,7 @@ def fit_detector(
         if "process_count" in params_of or any(
                 p.kind is inspect.Parameter.VAR_KEYWORD
                 for p in params_of.values()):
-            loader = loader_factory(roidb, cfg, n_local,
+            loader = loader_factory(roidb, loader_cfg, n_local,
                                     process_count=jax.process_count(),
                                     process_index=jax.process_index())
         else:
@@ -124,7 +135,7 @@ def fit_detector(
                 raise ValueError(
                     "loader_factory must accept process_count/process_index "
                     "kwargs to run multi-host")
-            loader = loader_factory(roidb, cfg, n_local)
+            loader = loader_factory(roidb, loader_cfg, n_local)
     steps_per_epoch = max(len(loader), 1)
 
     # Resume discovery BEFORE building the optimizer: a restored opt_state
@@ -176,7 +187,7 @@ def fit_detector(
                               forward_fn=forward_fn or forward_train,
                               param_specs=param_specs)
     rng = jax.random.PRNGKey(seed + 1)
-    batch_size = cfg.train.batch_images * n_data
+    batch_size = cfg.train.batch_images * accum * n_data
     speedometer = Speedometer(batch_size, frequent)
 
     # Async epoch-end saves (train/checkpoint.py CheckpointWriter); the

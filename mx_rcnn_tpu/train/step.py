@@ -49,37 +49,45 @@ def create_train_state(params, tx: optax.GradientTransformation) -> TrainState:
     )
 
 
-def _metrics_from_aux(aux: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-    """Per-batch values of the reference's 6 metrics (rcnn/core/metric.py).
+def _metric_parts(aux: Dict[str, jnp.ndarray]) -> Dict[str, tuple]:
+    """The reference's 6 metrics (rcnn/core/metric.py) as (num, den) pairs
+    so they pool EXACTLY across micro-steps: losses are (value, 1) means;
+    accuracies are (correct-count, valid-count) — summing parts then
+    dividing gives the big-batch value, which a mean-of-ratios would not.
 
     RPNAcc/RCNNAcc ignore label −1 exactly as the reference metrics mask
-    ignore labels; LogLoss values are the CE means; L1Loss are the scaled
-    smooth-L1 sums. Tolerant of partial aux (rpn-only / rcnn-only stages
-    emit their half, matching the metric lists of tools/train_rpn vs
-    train_rcnn in the reference).
+    ignore labels. Tolerant of partial aux (rpn-only / rcnn-only stages
+    emit their half; DETR emits rcnn_* losses without logits).
     """
-    eps = 1e-12
-    out = {"TotalLoss": aux["total_loss"]}
-    # Loss slots track the aux losses directly; accuracy slots need the
-    # logits/labels too. DETR emits rcnn_* losses without logits
-    # (models/detr.py metric-slot reuse), so the two are gated separately.
+    one = jnp.ones((), jnp.float32)
+    out = {"TotalLoss": (aux["total_loss"], one)}
     if "rpn_cls_loss" in aux:
-        out["RPNLogLoss"] = aux["rpn_cls_loss"]
-        out["RPNL1Loss"] = aux["rpn_bbox_loss"]
+        out["RPNLogLoss"] = (aux["rpn_cls_loss"], one)
+        out["RPNL1Loss"] = (aux["rpn_bbox_loss"], one)
     if "rpn_logits" in aux:
         rpn_pred = jnp.argmax(aux["rpn_logits"], axis=-1)
         rpn_valid = aux["rpn_labels"] >= 0
         rpn_correct = (rpn_pred == aux["rpn_labels"]) & rpn_valid
-        out["RPNAcc"] = jnp.sum(rpn_correct) / (jnp.sum(rpn_valid) + eps)
+        out["RPNAcc"] = (jnp.sum(rpn_correct).astype(jnp.float32),
+                         jnp.sum(rpn_valid).astype(jnp.float32))
     if "rcnn_cls_loss" in aux:
-        out["RCNNLogLoss"] = aux["rcnn_cls_loss"]
-        out["RCNNL1Loss"] = aux["rcnn_bbox_loss"]
+        out["RCNNLogLoss"] = (aux["rcnn_cls_loss"], one)
+        out["RCNNL1Loss"] = (aux["rcnn_bbox_loss"], one)
     if "rcnn_logits" in aux:
         rcnn_pred = jnp.argmax(aux["rcnn_logits"], axis=-1)
         rcnn_valid = aux["rcnn_labels"] >= 0
         rcnn_correct = (rcnn_pred == aux["rcnn_labels"]) & rcnn_valid
-        out["RCNNAcc"] = jnp.sum(rcnn_correct) / (jnp.sum(rcnn_valid) + eps)
+        out["RCNNAcc"] = (jnp.sum(rcnn_correct).astype(jnp.float32),
+                          jnp.sum(rcnn_valid).astype(jnp.float32))
     return out
+
+
+def _finalize_metrics(parts: Dict[str, tuple]) -> Dict[str, jnp.ndarray]:
+    return {k: num / (den + 1e-12) for k, (num, den) in parts.items()}
+
+
+def _metrics_from_aux(aux: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    return _finalize_metrics(_metric_parts(aux))
 
 
 def make_train_step(
@@ -105,14 +113,50 @@ def make_train_step(
     inserts the TP collectives alongside the data-axis gradient psum.
     """
 
-    def step(state: TrainState, batch, rng):
-        def loss_fn(params):
-            loss, aux = forward_fn(model, params, batch, rng, cfg)
+    accum = max(1, int(getattr(cfg.train, "grad_accum_steps", 1)))
+
+    def _grads_of(params, chunk, key):
+        def loss_fn(p):
+            loss, aux = forward_fn(model, p, chunk, key, cfg)
             return loss, aux
 
-        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        return grads, _metric_parts(aux)
+
+    def step(state: TrainState, batch, rng):
+        if accum == 1:
+            grads, parts = _grads_of(state.params, batch, rng)
+        else:
+            # Micro-step accumulation: the batch's leading dim is
+            # accum x micro-batch; grads average and metric PARTS sum
+            # (pooled accuracies = big-batch values) — identical gradient
+            # semantics to the big batch (per-image-normalized losses;
+            # frozen-BN / GroupNorm have no cross-batch coupling) at
+            # 1/accum of the activation memory. Accum is the INNER dim of
+            # the reshape so every chunk keeps one-row-per-device under
+            # the data mesh (outer would hand each chunk to a device
+            # subset and reshard every micro-step). The loop is UNROLLED
+            # (accum is a small static int): a lax.scan body holding the
+            # full fwd+bwd makes the SPMD partitioner pathologically slow
+            # to compile (measured >12 min for accum=2 at 64^2 on CPU;
+            # unrolled: seconds).
+            chunks = jax.tree.map(
+                lambda x: x.reshape(x.shape[0] // accum, accum,
+                                    *x.shape[1:]), batch)
+            keys = jax.random.split(rng, accum)
+            g_tot, p_tot = None, None
+            for i in range(accum):
+                chunk = jax.tree.map(lambda x: x[:, i], chunks)
+                g, p = _grads_of(state.params, chunk, keys[i])
+                if g_tot is None:
+                    g_tot, p_tot = g, p
+                else:
+                    g_tot = jax.tree.map(jnp.add, g_tot, g)
+                    p_tot = jax.tree.map(jnp.add, p_tot, p)
+            grads = jax.tree.map(lambda g: g / accum, g_tot)
+            parts = p_tot
         new_state = state.apply_gradients(grads)
-        return new_state, _metrics_from_aux(aux)
+        return new_state, _finalize_metrics(parts)
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
