@@ -79,10 +79,13 @@ class NetworkConfig:
     # with DP (data axis) and SP (same model axis, different tensors).
     tensor_parallel: bool = False
     # Pipeline parallelism for the ViT encoder (parallel/pipeline.py):
-    # pp_stages > 0 selects the staged backbone (ViTBackbonePP; depth must
-    # divide; each stage ends with a global-attention block — stages_n=4
-    # reproduces the ViTDet pattern) pipelined over the mesh `model` axis
-    # (whose size must equal pp_stages). Mutually exclusive with SP.
+    # pp_stages > 0 selects the staged backbone (ViTBackbonePP) pipelined
+    # over the mesh `model` axis (whose size must equal pp_stages). The
+    # staged model reproduces the sequential ViTDet global-attention
+    # placement EXACTLY for every buildable stage count; stage counts
+    # that cannot preserve it (placement not periodic in the stage size,
+    # e.g. depth 12 into 3 stages) hard-error at build time
+    # (models/vit.py::_stage_global_pattern). Mutually exclusive with SP.
     # pp_microbatches=0 → one microbatch per stage.
     pp_stages: int = 0
     pp_microbatches: int = 0
@@ -156,6 +159,13 @@ class TrainConfig:
     # the big batch. The reference has no equivalent (SURVEY.md §3.2).
     # 1 = off.
     grad_accum_steps: int = 1
+    # Multi-step dispatch: each host call drives this many FULL optimizer
+    # steps through one jitted lax.scan over step-stacked batches
+    # (train/step.py), amortizing the fixed per-dispatch host/relay
+    # overhead (~15-20 ms through the axon tunnel — PERF.md) across K
+    # steps. Orthogonal to grad_accum_steps (which merges micro-grads
+    # into ONE update; this performs K separate updates). 1 = off.
+    multi_step_dispatch: int = 1
     # Data
     batch_images: int = 1  # images per device
     shuffle: bool = True
@@ -293,32 +303,41 @@ _NETWORK_PRESETS: Mapping[str, Mapping[str, Any]] = {
     ),
     "resnet50": dict(name="resnet50", depth=50),
     "resnet101": dict(name="resnet101", depth=101),
+    # FPN-family presets default proposal_topk="approx": the per-level
+    # exact lax.top_k over the stride-4 level's ~123k scores costs
+    # ~2.2 ms/img in situ (7% of fwd+bwd, PERF.md r4 roofline) while
+    # approx_max_k (recall 0.95) only perturbs MEMBERSHIP at the pre-NMS
+    # tail — score order within the kept set is preserved, so NMS
+    # semantics are unchanged and the Detectron-lineage recipe is
+    # insensitive to the tail. `--set network.proposal_topk=exact`
+    # restores bit-deterministic selection (and stays the C4 default).
     "resnet50_fpn": dict(
         name="resnet50_fpn", depth=50, use_fpn=True, roi_pool_size=7,
-        anchor_scales=(8,),
+        anchor_scales=(8,), proposal_topk="approx",
     ),
     "resnet101_fpn": dict(
         name="resnet101_fpn", depth=101, use_fpn=True, roi_pool_size=7,
-        anchor_scales=(8,),
+        anchor_scales=(8,), proposal_topk="approx",
     ),
     "resnet50_fpn_mask": dict(
         name="resnet50_fpn_mask", depth=50, use_fpn=True, roi_pool_size=7,
-        anchor_scales=(8,), use_mask=True,
+        anchor_scales=(8,), use_mask=True, proposal_topk="approx",
     ),
     "resnet101_fpn_mask": dict(
         name="resnet101_fpn_mask", depth=101, use_fpn=True, roi_pool_size=7,
-        anchor_scales=(8,), use_mask=True,
+        anchor_scales=(8,), use_mask=True, proposal_topk="approx",
     ),
     "vitdet_b": dict(
         name="vitdet_b", use_vit=True, roi_pool_size=7, anchor_scales=(8,),
         vit_dim=768, vit_depth=12, vit_heads=12, vit_window=8,
         norm="group",  # detector-side norms; the ViT itself uses LayerNorm
+        proposal_topk="approx",
     ),
     "vitdet_b_mask": dict(
         name="vitdet_b_mask", use_vit=True, roi_pool_size=7,
         anchor_scales=(8,), use_mask=True,
         vit_dim=768, vit_depth=12, vit_heads=12, vit_window=8,
-        norm="group",
+        norm="group", proposal_topk="approx",
     ),
     "detr_r50": dict(name="detr_r50", depth=50, use_detr=True),
 }
@@ -465,6 +484,13 @@ def parse_cli_overrides(pairs) -> dict:
     literal fallback so '--set network.tensor_parallel=false' can never
     come through as a truthy string; anything else unparseable stays a
     string (e.g. network.norm=group).
+
+    Caveat: the bool coercion is unconditional (it does not consult the
+    target field's type), so a STRING-typed field can never receive the
+    literal strings 'true'/'false'/'yes'/'no'/'on'/'off' (or quoted
+    variants — quotes survive literal_eval as str only for other values)
+    through --set. No current config field has such a value domain; if
+    one ever does, route it around --set or rename the value.
     """
     import ast
 

@@ -135,6 +135,68 @@ class IMDB:
         image coordinates. Returns metric dict (e.g. {'mAP': ...})."""
         raise NotImplementedError
 
+    def evaluate_recall(self, roidb: List[Dict],
+                        candidate_boxes: Optional[List[np.ndarray]] = None,
+                        at=(300, 1000, 2000),
+                        iou_thresh: float = 0.5) -> Dict[str, float]:
+        """Proposal recall over a roidb — the classic way to grade an RPN
+        stage without training the RCNN head (reference:
+        rcnn/dataset/imdb.py::evaluate_recall driven by tools/test_rpn.py).
+
+        candidate_boxes[i]: (n, 4|5) [x1,y1,x2,y2(,score)] proposals for
+        image i in DESCENDING score order (generate_proposals' dump order;
+        a (n,5) array with a score column is re-sorted by it to be safe).
+        None → entry['proposals'] from an attached roidb. Returns
+        {'recall@N': covered-gt / total-gt at IoU >= iou_thresh using the
+        top-N proposals per image} plus 'num_gt'/'num_proposals' counts.
+
+        Matching is GREEDY ONE-TO-ONE exactly as the reference: repeatedly
+        take the (proposal, gt) pair with the highest IoU, record it, and
+        remove both — a single proposal covering two clustered gts counts
+        ONE, not two.
+        """
+        cutoffs = sorted(int(n) for n in at)
+        covered = {n: 0 for n in cutoffs}
+        num_gt = 0
+        num_props = 0
+        for i, entry in enumerate(roidb):
+            gt = np.asarray(entry["boxes"], np.float32).reshape(-1, 4)
+            if "gt_classes" in entry:
+                gt = gt[np.asarray(entry["gt_classes"]) > 0]
+            props = (candidate_boxes[i] if candidate_boxes is not None
+                     else entry.get("proposals"))
+            props = (np.zeros((0, 4), np.float32) if props is None
+                     else np.asarray(props, np.float32))
+            if props.ndim == 2 and props.shape[1] == 5:
+                props = props[np.argsort(-props[:, 4])][:, :4]
+            num_gt += len(gt)
+            num_props += len(props)
+            if not len(gt) or not len(props):
+                continue
+            from mx_rcnn_tpu.evaluation.voc_eval import _iou_matrix
+
+            iou_full = _iou_matrix(props, gt)  # (P, G), host-side numpy
+            for n in cutoffs:
+                # Greedy one-to-one: best remaining pair wins, both drop.
+                iou = iou_full[:n].copy()
+                for _ in range(min(len(gt), iou.shape[0])):
+                    p_idx, g_idx = np.unravel_index(iou.argmax(),
+                                                    iou.shape)
+                    if iou[p_idx, g_idx] < iou_thresh:
+                        break
+                    covered[n] += 1
+                    iou[p_idx, :] = -1
+                    iou[:, g_idx] = -1
+        out = {f"recall@{n}": (covered[n] / num_gt if num_gt else 0.0)
+               for n in cutoffs}
+        out["num_gt"] = float(num_gt)
+        out["num_proposals"] = float(num_props)
+        logger.info(
+            "%s proposal recall (IoU>=%.2f): %s", self.name, iou_thresh,
+            "  ".join(f"recall@{n}={out[f'recall@{n}']:.4f}"
+                      for n in cutoffs))
+        return out
+
 
 def filter_roidb(roidb: List[Dict]) -> List[Dict]:
     """Drop images without valid gt (reference:

@@ -10,7 +10,10 @@ TPU deltas:
 - every batch has ONE static shape (config.image.pad_shape + max_gt_boxes);
 - a worker-thread pool decodes/resizes ahead of the device (the reference
   overlaps only via MXNet's PrefetchingIter when wired, SURVEY.md §4.1 'hot
-  loops');
+  loops'). Thread scaling is INVERSE beyond ~2 workers (GIL contention on
+  the numpy normalize/pad stages — measured 71.8 img/s at 1 worker vs
+  52.3 at 8, flagship shapes; PERF.md r4), so the default is 2; the
+  packed shard format (data/packed.py) is the throughput path;
 - aspect grouping survives as a perf knob (groups portrait/landscape so the
   short-side resize wastes less canvas), not a correctness feature.
 """
@@ -66,7 +69,14 @@ def resolve_pad_bucket(cfg: Config, scale_idx: int,
 def _load_roidb_entry(entry: Dict, cfg: Config, scale_idx: int = 0,
                       pad: Optional[tuple] = None):
     """roidb record → (padded image f32 HWC, im_info, boxes, classes) at the
-    chosen training scale. Handles the `flipped` flag the imdb sets."""
+    chosen training scale. Handles the `flipped` flag the imdb sets.
+
+    Packed entries (data/packed.py shards) take the mmap fast path: the
+    decode+resize already happened at pack time."""
+    if "packed_file" in entry:
+        from mx_rcnn_tpu.data.packed import load_packed_entry
+
+        return load_packed_entry(entry, cfg, scale_idx, pad)
     if "image_data" in entry:  # synthetic datasets embed pixels directly
         img = entry["image_data"].astype(np.float32)
     else:
@@ -206,7 +216,7 @@ class AnchorLoader:
 
     def __init__(self, roidb: List[Dict], cfg: Config, num_shards: int = 1,
                  shuffle: Optional[bool] = None, seed: int = 0,
-                 prefetch_depth: int = 4, workers: int = 4,
+                 prefetch_depth: int = 4, workers: int = 2,
                  process_count: int = 1, process_index: int = 0):
         """num_shards = data-axis shards THIS process feeds. Multi-host
         (process_count > 1): every process must use the SAME seed — the

@@ -1,0 +1,174 @@
+"""Packed pre-decoded shard format for the input pipeline.
+
+SURVEY.md §8 hard-part 5: host cv2 JPEG decode + resize cannot sustain a
+v5e chip (measured: ~19 img/s single-thread, with INVERSE thread scaling
+from GIL contention, vs a 40-55 img/s chip step rate — PERF.md r4). The
+reference has no equivalent (MXNet's .rec IndexedRecordIO is the closest
+ancestor); this is the TPU-era replacement: decode and resize ONCE at pack
+time, then train-time loading is an mmap slice + normalize + pad.
+
+Format (one directory):
+  shard_{k:04d}.npy   (N, Hb, Wb, 3) uint8 RGB, mmap-able; every image is
+                      resized to the packed scale and zero-padded to its
+                      ORIENTED pad bucket (landscape/portrait shards are
+                      packed separately so rows are uniform).
+  manifest.pkl        per-image dicts: shard path/row, resized (rh, rw),
+                      scale, original roidb gt fields (boxes in ORIGINAL
+                      coordinates, gt_classes, segmentations/gt_masks...).
+
+`load_packed_roidb(dir)` returns a normal roidb whose entries carry
+packed_* keys; data/loader.py::_load_roidb_entry takes the mmap fast path
+for them — same AnchorLoader/ROIIter API, same batches, no other changes.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.logger import logger
+
+_GT_KEYS = ("gt_classes", "segmentations", "gt_masks")
+
+
+def _oriented_bucket(cfg: Config, scale_idx: int, landscape: bool) -> tuple:
+    from mx_rcnn_tpu.data.loader import pad_shape_for
+
+    h, w = pad_shape_for(cfg, scale_idx)
+    h, w = min(h, w), max(h, w)
+    return (h, w) if landscape else (w, h)
+
+
+def write_packed_dataset(roidb: List[Dict], cfg: Config, out_dir: str,
+                         scale_idx: int = 0,
+                         shard_images: int = 512) -> str:
+    """Decode+resize every roidb image once and write packed shards.
+
+    Only UNFLIPPED entries are packed (flip is a view at load time —
+    append_flipped_images after load_packed_roidb works as usual).
+    """
+    from mx_rcnn_tpu.data.image import load_image, resize_image
+
+    os.makedirs(out_dir, exist_ok=True)
+    target, max_size = cfg.image.scales[scale_idx]
+    manifest: List[Dict] = []
+    # Group by orientation so every shard has uniform row shape.
+    by_orient = {True: [], False: []}
+    for i, entry in enumerate(roidb):
+        if entry.get("flipped"):
+            raise ValueError(
+                "pack the UNFLIPPED roidb; apply append_flipped_images "
+                "after load_packed_roidb")
+        landscape = entry.get("width", 1) >= entry.get("height", 1)
+        by_orient[landscape].append(i)
+
+    shard_id = 0
+    for landscape, idxs in by_orient.items():
+        bucket = _oriented_bucket(cfg, scale_idx, landscape)
+        for lo in range(0, len(idxs), shard_images):
+            chunk = idxs[lo:lo + shard_images]
+            arr = np.zeros((len(chunk), *bucket, 3), np.uint8)
+            rows = []
+            for row, i in enumerate(chunk):
+                entry = roidb[i]
+                img = (entry["image_data"].astype(np.float32)
+                       if "image_data" in entry
+                       else load_image(entry["image"]))
+                img, scale = resize_image(img, target, max_size)
+                rh, rw = img.shape[:2]
+                if rh > bucket[0] or rw > bucket[1]:
+                    raise ValueError(
+                        f"resized image ({rh},{rw}) exceeds pad bucket "
+                        f"{bucket} — check image.scales/pad_shapes")
+                arr[row, :rh, :rw] = np.clip(np.rint(img), 0,
+                                             255).astype(np.uint8)
+                rows.append((i, rh, rw, float(scale)))
+            path = os.path.join(out_dir, f"shard_{shard_id:04d}.npy")
+            np.save(path, arr)
+            for row, (i, rh, rw, scale) in enumerate(rows):
+                entry = roidb[i]
+                rec = {
+                    "packed_file": os.path.basename(path),
+                    "packed_index": row,
+                    "packed_hw": (rh, rw),
+                    "packed_scale": scale,
+                    "packed_scale_idx": scale_idx,
+                    "height": entry.get("height"),
+                    "width": entry.get("width"),
+                    "boxes": np.asarray(entry["boxes"], np.float32),
+                    "flipped": False,
+                }
+                for k in _GT_KEYS:
+                    if k in entry:
+                        rec[k] = entry[k]
+                manifest.append(rec)
+            shard_id += 1
+    mpath = os.path.join(out_dir, "manifest.pkl")
+    with open(mpath, "wb") as f:
+        pickle.dump(manifest, f, pickle.HIGHEST_PROTOCOL)
+    logger.info("packed %d images into %d shards under %s",
+                len(manifest), shard_id, out_dir)
+    return mpath
+
+
+def load_packed_roidb(out_dir: str) -> List[Dict]:
+    """Manifest → roidb (entries carry packed_* keys; paths resolved)."""
+    with open(os.path.join(out_dir, "manifest.pkl"), "rb") as f:
+        manifest = pickle.load(f)
+    for rec in manifest:
+        rec["packed_file"] = os.path.join(out_dir, rec["packed_file"])
+    return manifest
+
+
+# -- load-time fast path (called from data/loader.py) -----------------------
+
+_MMAPS: Dict[str, np.ndarray] = {}
+_MMAP_LOCK = threading.Lock()
+
+
+def _shard_mmap(path: str) -> np.ndarray:
+    arr = _MMAPS.get(path)
+    if arr is None:
+        with _MMAP_LOCK:
+            arr = _MMAPS.get(path)
+            if arr is None:
+                arr = np.load(path, mmap_mode="r")
+                _MMAPS[path] = arr
+    return arr
+
+
+def load_packed_entry(entry: Dict, cfg: Config, scale_idx: int,
+                      pad: Optional[tuple]):
+    """Packed analog of loader._load_roidb_entry: mmap slice → f32 →
+    normalize → pad. Returns (img, im_info, boxes, classes)."""
+    from mx_rcnn_tpu.data.image import pad_image, transform_image
+    from mx_rcnn_tpu.data.loader import pad_shape_for
+
+    if scale_idx != entry["packed_scale_idx"]:
+        raise ValueError(
+            f"packed at scale_idx {entry['packed_scale_idx']} but batch "
+            f"drew scale_idx {scale_idx}; pack every training scale or "
+            "use a single-scale config")
+    rh, rw = entry["packed_hw"]
+    scale = entry["packed_scale"]
+    img_u8 = np.asarray(_shard_mmap(entry["packed_file"])
+                        [entry["packed_index"], :rh, :rw])
+    boxes = entry["boxes"].astype(np.float32).copy()
+    if entry.get("flipped"):
+        img_u8 = img_u8[:, ::-1]
+        w0 = entry["width"]
+        x1 = boxes[:, 0].copy()
+        boxes[:, 0] = w0 - boxes[:, 2] - 1
+        boxes[:, 2] = w0 - x1 - 1
+    boxes *= scale
+    img = transform_image(img_u8.astype(np.float32),
+                          cfg.image.pixel_means, cfg.image.pixel_stds)
+    img = pad_image(img, pad if pad is not None
+                    else pad_shape_for(cfg, scale_idx))
+    im_info = np.asarray([rh, rw, scale], np.float32)
+    return img, im_info, boxes, entry["gt_classes"].astype(np.int32)

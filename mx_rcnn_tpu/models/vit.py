@@ -118,6 +118,35 @@ def _global_block_indices(depth: int) -> set:
     return {i for i in blocks if i >= 0} or {depth - 1}
 
 
+def _stage_global_pattern(depth: int, stages_n: int):
+    """In-stage indices of the global-attention blocks for a staged split
+    of the sequential backbone — the SAME tuple for every stage, so the
+    stages are identically structured (what nn.scan and the GPipe ring
+    need), or ValueError when no such split exists.
+
+    The sequential placement is periodic with period depth/4, so any
+    stages_n dividing 4 preserves it exactly (depth 12, 2 stages → {2, 5}
+    in both halves); degenerate all-global depths support any divisor.
+    Splits that would change the architecture (e.g. depth 12 into 3
+    stages) hard-error instead of silently training a different model."""
+    if stages_n <= 0 or depth % stages_n:
+        raise ValueError(
+            f"vit_depth {depth} must divide into pp_stages {stages_n}")
+    per = depth // stages_n
+    g = _global_block_indices(depth)
+    pats = [tuple(sorted(i - s * per for i in g
+                         if s * per <= i < (s + 1) * per))
+            for s in range(stages_n)]
+    if any(p != pats[0] for p in pats[1:]):
+        raise ValueError(
+            f"pp_stages={stages_n} cannot preserve the ViTDet global-"
+            f"attention placement at depth {depth}: the sequential globals "
+            f"{sorted(g)} split into unequal per-stage patterns {pats}; "
+            "pipeline stages must be identically structured. Use a stage "
+            "count that divides 4 (the placement period is depth/4).")
+    return pats[0]
+
+
 def _embed_patches(mdl, x: jnp.ndarray) -> jnp.ndarray:
     """Shared embed surface: patch Conv + bilinearly-resized absolute
     pos-embed. Called from the compact bodies of BOTH backbones (same
@@ -171,28 +200,33 @@ class ViTBackbone(nn.Module):
 
 
 class ViTStage(nn.Module):
-    """One pipeline stage: (blocks-1) windowed Blocks + a global tail.
+    """One pipeline stage: ``blocks`` Blocks, global attention at the
+    static in-stage indices ``globals_idx`` (windowed elsewhere).
 
-    The ViTDet quarter pattern — every depth/4 subset ends with a global
-    block — makes the encoder a stack of IDENTICALLY-STRUCTURED stages,
+    The ViTDet placement is periodic in the stage size for any supported
+    stage count (_stage_global_pattern), so every stage carries the SAME
+    globals_idx — the encoder is a stack of IDENTICALLY-STRUCTURED stages,
     which is exactly what pipeline parallelism needs (ring-homogeneous,
     shape-preserving). nn.scan-compatible signature: (carry, None) ->
-    (carry, None).
+    (carry, None). Blocks are named positionally (b0..b{blocks-1}):
+    Block params are window-independent, so the name encodes position
+    only and the checkpoint layout is placement-agnostic.
     """
 
     dim: int
     heads: int
     window: int
     blocks: int
+    globals_idx: tuple = ()
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, _=None):
-        for i in range(self.blocks - 1):
-            x = Block(self.dim, self.heads, window=self.window,
-                      dtype=self.dtype, name=f"win{i}")(x)
-        x = Block(self.dim, self.heads, window=0, dtype=self.dtype,
-                  name="glob")(x)
+        for i in range(self.blocks):
+            is_global = i in self.globals_idx
+            x = Block(self.dim, self.heads,
+                      window=0 if is_global else self.window,
+                      dtype=self.dtype, name=f"b{i}")(x)
         return x, None
 
 
@@ -203,9 +237,10 @@ class ViTBackbonePP(nn.Module):
     ``stages_n`` scanned ViTStages (params stacked on a leading stage axis
     by nn.scan). Sequential execution (pipeline_fn=None) and pipelined
     execution (parallel/pipeline.py::pipeline_apply over the mesh `model`
-    axis) share the SAME parameters and numerics; with stages_n=4 and
-    blocks_per_stage=depth/4 the global-attention placement matches
-    ViTBackbone's ViTDet pattern exactly.
+    axis) share the SAME parameters and numerics. The global-attention
+    placement matches ViTBackbone's ViTDet pattern EXACTLY for every
+    supported stage count (_stage_global_pattern hard-errors on splits
+    that cannot preserve it).
     """
 
     patch: int = 16
@@ -221,7 +256,11 @@ class ViTBackbonePP(nn.Module):
     def __call__(self, x: jnp.ndarray, pipeline_fn=None) -> jnp.ndarray:
         x = _embed_patches(self, x)
         stage_kw = dict(dim=self.dim, heads=self.heads, window=self.window,
-                        blocks=self.blocks_per_stage, dtype=self.dtype)
+                        blocks=self.blocks_per_stage,
+                        globals_idx=_stage_global_pattern(
+                            self.stages_n * self.blocks_per_stage,
+                            self.stages_n),
+                        dtype=self.dtype)
         ScanStages = nn.scan(
             ViTStage, variable_axes={"params": 0},
             split_rngs={"params": True}, length=self.stages_n)
@@ -315,10 +354,10 @@ class ViTDet(nn.Module):
 
     def setup(self):
         if self.pp_stages:
-            if self.depth % self.pp_stages:
-                raise ValueError(
-                    f"vit_depth {self.depth} must divide into pp_stages "
-                    f"{self.pp_stages}")
+            # Raises when depth doesn't divide OR the split can't preserve
+            # the ViTDet global-attention placement (hard error, not a
+            # warning — a silently different architecture is a trap).
+            _stage_global_pattern(self.depth, self.pp_stages)
             self.features = ViTBackbonePP(
                 patch=self.patch, dim=self.dim, stages_n=self.pp_stages,
                 blocks_per_stage=self.depth // self.pp_stages,
@@ -389,16 +428,11 @@ def build_vitdet_model(cfg: Config, global_attn_fn=None,
             "network.tensor_parallel and network.pp_stages both claim the "
             "mesh 'model' axis (TP rules would shard the stacked STAGE "
             "axis of the scanned stage params); enable only one")
-    if pp_stages and pp_stages != 4:
-        from mx_rcnn_tpu.logger import logger
-
-        logger.warning(
-            "pp_stages=%d: the staged backbone places ONE global-attention "
-            "block per stage (at each stage tail), so this is a different "
-            "global placement than ViTBackbone's depth/4 pattern — "
-            "checkpoints/accuracy are not comparable to the non-PP model; "
-            "pp_stages=4 reproduces the ViTDet architecture exactly",
-            pp_stages)
+    if pp_stages:
+        # Fail fast (before init) on splits that would change the
+        # architecture; every constructible staged model preserves the
+        # sequential global placement exactly.
+        _stage_global_pattern(cfg.network.vit_depth, pp_stages)
     return ViTDet(
         num_classes=cfg.dataset.num_classes,
         num_anchors=cfg.network.num_anchors,
@@ -424,10 +458,11 @@ def sequential_to_staged(params, stages_n: int):
     layout (`features/stages` with leaves stacked on a leading stage axis).
 
     Enables the train-small → scale-out path: fit with the default
-    backbone on one chip, then resume/continue under pp_stages. Only valid
-    when the architectures coincide — stages_n == 4 (or depth < 4), since
-    each ViTStage ends with its global block (see build_vitdet_model
-    warning). Non-backbone leaves pass through unchanged.
+    backbone on one chip, then resume/continue under pp_stages. Valid for
+    every stage count the staged backbone itself supports — i.e. whenever
+    _stage_global_pattern(depth, stages_n) exists, the staged model runs
+    the IDENTICAL architecture (ValueError otherwise). Non-backbone
+    leaves pass through unchanged.
     """
     feats = params["params"]["features"]
     blocks = sorted((k for k in feats if k.startswith("block")),
@@ -437,22 +472,12 @@ def sequential_to_staged(params, stages_n: int):
         raise ValueError(
             "no features/block* leaves — not a sequential-backbone param "
             "tree (already staged?)")
-    if depth % stages_n:
-        raise ValueError(f"depth {depth} must divide into {stages_n} stages")
+    _stage_global_pattern(depth, stages_n)  # architecture must be preserved
     per = depth // stages_n
-    stage_tails = {(s + 1) * per - 1 for s in range(stages_n)}
-    if stage_tails != _global_block_indices(depth):
-        raise ValueError(
-            f"sequential globals at {sorted(_global_block_indices(depth))} "
-            f"don't match the stage tails {sorted(stage_tails)} of a "
-            f"{stages_n}-stage layout; the architectures differ "
-            "(use stages_n=4)")
 
-    # ViTStage names its blocks win0..win{per-2}, glob.
+    # ViTStage names its blocks positionally: b0..b{per-1}.
     def stage_tree(s):
-        names = [f"win{i}" for i in range(per - 1)] + ["glob"]
-        return {name: feats[blocks[s * per + j]]
-                for j, name in enumerate(names)}
+        return {f"b{j}": feats[blocks[s * per + j]] for j in range(per)}
 
     stages = jax.tree.map(lambda *leaves: jnp.stack(leaves),
                           *[stage_tree(s) for s in range(stages_n)])
@@ -465,10 +490,10 @@ def staged_to_sequential(params):
     """Inverse of sequential_to_staged (stacked stages → block{i}).
 
     Validates the same architecture constraint as the forward direction:
-    a staged layout whose stage tails don't coincide with the sequential
-    backbone's global placement (pp_stages != 4) would convert into
-    params that LOAD cleanly (Block shapes are window-independent) but
-    run the wrong attention pattern — rejected instead.
+    a staged layout whose (stages_n, per) split cannot preserve the
+    sequential backbone's global placement would convert into params that
+    LOAD cleanly (Block shapes are window-independent) but run the wrong
+    attention pattern — the architectures differ, so it is rejected.
     """
     feats = params["params"]["features"]
     if "stages" not in feats:
@@ -476,18 +501,21 @@ def staged_to_sequential(params):
             "no features/stages subtree — not a staged-backbone param tree")
     stages = feats["stages"]
     stages_n = jax.tree.leaves(stages)[0].shape[0]
-    names = sorted((k for k in stages if k.startswith("win")),
-                   key=lambda k: int(k[3:])) + ["glob"]
+    names = sorted((k for k in stages
+                    if k.startswith("b") and k[1:].isdigit()),
+                   key=lambda k: int(k[1:]))
+    if not names or len(names) != len(stages):
+        raise ValueError(
+            f"stage blocks {sorted(stages)} are not the positional "
+            "b0..b{n} layout — a pre-round-4 staged checkpoint "
+            "(win{i}/glob names) must be converted by the round that "
+            "wrote it; refusing to silently drop blocks")
     per = len(names)
     depth = stages_n * per
-    stage_tails = {(s + 1) * per - 1 for s in range(stages_n)}
-    if stage_tails != _global_block_indices(depth):
-        raise ValueError(
-            f"staged layout has global blocks at stage tails "
-            f"{sorted(stage_tails)} but the sequential backbone at depth "
-            f"{depth} places them at "
-            f"{sorted(_global_block_indices(depth))}; the architectures "
-            "differ (only stages_n=4 checkpoints convert)")
+    try:
+        _stage_global_pattern(depth, stages_n)
+    except ValueError as e:
+        raise ValueError(f"the architectures differ: {e}") from e
     new_feats = {k: v for k, v in feats.items() if k != "stages"}
     for s in range(stages_n):
         for j, name in enumerate(names):

@@ -100,7 +100,8 @@ def tp_param_specs(params, rules: Sequence[Tuple[str, P]] = TP_RULES):
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
-def _validated_sharding(mesh: Mesh, spec: P, shape) -> NamedSharding:
+def _validated_sharding(mesh: Mesh, spec: P, shape,
+                        name: str = "?") -> NamedSharding:
     """Spec → NamedSharding; drop to replicated if a sharded dim is not
     divisible by its mesh-axis size (GSPMD would pad, but for the small
     test/head dims an even split either exists or the layer is too small
@@ -110,6 +111,10 @@ def _validated_sharding(mesh: Mesh, spec: P, shape) -> NamedSharding:
             continue
         size = mesh.shape[axes] if isinstance(axes, str) else 1
         if dim >= len(shape) or shape[dim] % size != 0:
+            logger.warning(
+                "TP rule %s for param %s (shape %s) dropped: dim %d not "
+                "divisible by mesh axis %r (size %d) — replicating",
+                spec, name, tuple(shape), dim, axes, size)
             return NamedSharding(mesh, P())
     return NamedSharding(mesh, spec)
 
@@ -117,9 +122,15 @@ def _validated_sharding(mesh: Mesh, spec: P, shape) -> NamedSharding:
 def shard_params(params, mesh: Mesh, specs=None):
     """Place a (host or replicated) params tree per the spec tree."""
     specs = specs if specs is not None else tp_param_specs(params)
-    shardings = jax.tree.map(
-        lambda spec, leaf: _validated_sharding(mesh, spec, leaf.shape),
-        specs, params, is_leaf=lambda x: isinstance(x, P))
+    flat_p, treedef = jax.tree_util.tree_flatten_with_path(params)
+    flat_s, spec_def = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    if spec_def != treedef:  # stale/mismatched spec tree must not
+        raise ValueError(    # silently misalign shardings
+            f"spec tree does not match params tree: {spec_def} vs {treedef}")
+    shardings = jax.tree_util.tree_unflatten(treedef, [
+        _validated_sharding(mesh, spec, leaf.shape, _path_str(path))
+        for (path, leaf), spec in zip(flat_p, flat_s)])
     return jax.device_put(params, shardings), shardings
 
 

@@ -1,0 +1,122 @@
+"""From-data bbox-regression target statistics.
+
+Reference: rcnn/processing/bbox_regression.py::add_bbox_regression_targets —
+when ``BBOX_NORMALIZATION_PRECOMPUTED`` is false the reference sweeps the
+roidb once, collects the (dx, dy, dw, dh) regression targets of every
+foreground (proposal, matched-gt) pair, and normalizes training targets by
+the measured mean/std instead of the hard-coded (0, 0.1/0.2) constants.
+
+Here normalization happens in-graph (targets/rcnn_targets.py::sample_rois
+reads cfg.train.bbox_means/bbox_stds), so the from-data branch computes the
+same statistics on the host and returns an UPDATED config — one sweep before
+training, zero per-step cost. Class-agnostic 4-vectors, matching the shape
+sample_rois consumes (the per-class expansion happens in-graph as with the
+precomputed constants).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.logger import logger
+
+
+def _flip_x(boxes: np.ndarray, width: float) -> np.ndarray:
+    """Horizontal mirror, inclusive-pixel convention (x1' = W-1-x2)."""
+    out = boxes.copy()
+    out[:, 0] = width - 1 - boxes[:, 2]
+    out[:, 2] = width - 1 - boxes[:, 0]
+    return out
+
+
+def _transform_np(ex: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """(dx, dy, dw, dh) targets, numpy host path (ops/boxes.py
+    bbox_transform semantics, +1 box widths as in the classic lineage)."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * (ew - 1.0)
+    ecy = ex[:, 1] + 0.5 * (eh - 1.0)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1.0)
+    gcy = gt[:, 1] + 0.5 * (gh - 1.0)
+    return np.stack([
+        (gcx - ecx) / (ew + 1e-14),
+        (gcy - ecy) / (eh + 1e-14),
+        np.log(gw / ew),
+        np.log(gh / eh),
+    ], axis=1)
+
+
+def compute_bbox_stats(roidb: List[Dict],
+                       fg_overlap: float = 0.5) -> Tuple[tuple, tuple]:
+    """Sweep a (proposal-attached) roidb and return (means, stds) of the
+    foreground regression targets.
+
+    Each entry contributes its proposals (entry['proposals'], the Fast
+    R-CNN path; gt boxes stand in when absent — matching the reference,
+    whose roidb['boxes'] always includes gt rows) matched to their
+    max-IoU gt; pairs with IoU >= fg_overlap are foreground. Degenerate
+    sweeps (no fg pairs) fall back to the classic precomputed constants.
+    """
+    from mx_rcnn_tpu.evaluation.voc_eval import _iou_matrix
+
+    sums = np.zeros(4, np.float64)
+    sqs = np.zeros(4, np.float64)
+    count = 0
+    for entry in roidb:
+        gt = np.asarray(entry["boxes"], np.float64).reshape(-1, 4)
+        if "gt_classes" in entry:
+            gt = gt[np.asarray(entry["gt_classes"]) > 0]
+        if not len(gt):
+            continue
+        props = entry.get("proposals")
+        props = (gt if props is None
+                 else np.asarray(props, np.float64).reshape(-1, 4))
+        if not len(props):
+            continue
+        if entry.get("flipped"):
+            # Flipped roidb copies share the UNFLIPPED arrays (the loader
+            # mirrors at load time), but training consumes the mirrored
+            # targets (dx negated) for these entries — mirror here so the
+            # statistics match the distribution being normalized
+            # (reference sweeps post-flip boxes).
+            w0 = entry["width"]
+            gt = _flip_x(gt, w0)
+            props = _flip_x(props, w0)
+        iou = _iou_matrix(props, gt)
+        argmax = iou.argmax(axis=1)
+        fg = iou[np.arange(len(props)), argmax] >= fg_overlap
+        if not fg.any():
+            continue
+        t = _transform_np(props[fg], gt[argmax[fg]])
+        sums += t.sum(axis=0)
+        sqs += (t ** 2).sum(axis=0)
+        count += len(t)
+    if count < 2:
+        logger.warning(
+            "compute_bbox_stats: %d fg pairs — falling back to the classic "
+            "precomputed constants", count)
+        return (0.0, 0.0, 0.0, 0.0), (0.1, 0.1, 0.2, 0.2)
+    means = sums / count
+    var = np.maximum(sqs / count - means ** 2, 1e-12)
+    stds = np.sqrt(var)
+    logger.info("bbox target stats over %d fg pairs: means=%s stds=%s",
+                count, np.round(means, 4), np.round(stds, 4))
+    return tuple(float(m) for m in means), tuple(float(s) for s in stds)
+
+
+def resolve_bbox_stats(cfg: Config, roidb: List[Dict]) -> Config:
+    """The BBOX_NORMALIZATION_PRECOMPUTED switch: precomputed=True (the
+    classic default) keeps cfg's constants; False measures means/stds from
+    the roidb and returns an updated config (which also flows into the
+    checkpoint's unnormalization contract via train.bbox_means/stds)."""
+    if cfg.train.bbox_normalization_precomputed:
+        return cfg
+    means, stds = compute_bbox_stats(roidb, fg_overlap=cfg.train.fg_thresh)
+    return cfg.with_updates(train=replace(
+        cfg.train, bbox_means=means, bbox_stds=stds))

@@ -55,22 +55,31 @@ def train_rpn(cfg: Config, prefix: str, pretrained_params=None,
 
 
 def test_rpn_generate(cfg: Config, params, rpn_file: str,
-                      image_set: Optional[str] = None):
-    """Dump RPN proposals for an image set (reference: tools/test_rpn.py
-    --gen → tester.generate_proposals)."""
+                      image_set: Optional[str] = None,
+                      report_recall: bool = True):
+    """Dump RPN proposals for an image set and grade them by proposal
+    recall vs gt (reference: tools/test_rpn.py --gen →
+    tester.generate_proposals, then imdb.evaluate_recall — the classic
+    check on an alternate stage-1/5 RPN without training the head).
+
+    Returns (files, recalls): one proposal pickle and one recall dict
+    (recall@{300,1000,2000} at IoU 0.5) per image set.
+    """
     image_set = image_set or cfg.dataset.image_set
     sets = image_set.split("+")
     model = build_model(cfg)
     predictor = Predictor(model, params, cfg)
-    files = []
+    files, recalls = [], []
     for s in sets:
         ds = dataset_from_config(cfg.dataset, s)
         roidb = ds.gt_roidb()
         loader = TestLoader(roidb, cfg, batch_size=1)
         f = rpn_file if len(sets) == 1 else f"{rpn_file}.{s}"
-        generate_proposals(predictor, loader, f)
+        proposals = generate_proposals(predictor, loader, f)
         files.append(f)
-    return files
+        if report_recall:
+            recalls.append(ds.evaluate_recall(roidb, proposals))
+    return files, recalls
 
 
 def _attach_proposals(cfg: Config, rpn_file: str) -> List[Dict]:
@@ -95,8 +104,12 @@ def train_rcnn(cfg: Config, prefix: str, rpn_file: str,
                frozen_trunk: bool = False, mesh_spec: str = "",
                frequent: int = 20, seed: int = 0, max_proposals: int = 2000):
     """Fast-R-CNN fit over precomputed proposals (reference:
-    tools/train_rcnn.py over ROIIter)."""
+    tools/train_rcnn.py over ROIIter, incl. its add_bbox_regression_targets
+    call when bbox normalization is not precomputed)."""
+    from mx_rcnn_tpu.targets.bbox_stats import resolve_bbox_stats
+
     roidb = _attach_proposals(cfg, rpn_file)
+    cfg = resolve_bbox_stats(cfg, roidb)
     return fit_detector(
         cfg, roidb, prefix,
         end_epoch=end_epoch,

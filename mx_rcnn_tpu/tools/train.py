@@ -47,6 +47,23 @@ def load_gt_roidbs(cfg: Config, image_set: Optional[str] = None,
     return filter_roidb(merge_roidb(roidbs))
 
 
+def _dispatch_batches(loader, multi: int):
+    """Group the loader stream into multi-step-dispatch super-batches:
+    K consecutive batches stacked on a NEW leading step axis (leaves
+    (K, B, ...) — train/step.py scans one optimizer step per row). K=1
+    passes batches through untouched. A trailing partial group is dropped
+    (logged by fit_detector) — an epoch boundary effect only."""
+    if multi <= 1:
+        yield from loader
+        return
+    group = []
+    for batch in loader:
+        group.append(batch)
+        if len(group) == multi:
+            yield {k: np.stack([b[k] for b in group]) for k in group[0]}
+            group = []
+
+
 def fit_detector(
     cfg: Config,
     roidb: List[Dict],
@@ -172,7 +189,7 @@ def fit_detector(
 
     param_specs = None
     if cfg.network.tensor_parallel:
-        if mesh.shape["model"] > 1:
+        if "model" in mesh.axis_names and mesh.shape["model"] > 1:
             from mx_rcnn_tpu.parallel.partition import (
                 shard_train_state, tp_param_specs)
 
@@ -187,7 +204,12 @@ def fit_detector(
                               forward_fn=forward_fn or forward_train,
                               param_specs=param_specs)
     rng = jax.random.PRNGKey(seed + 1)
-    batch_size = cfg.train.batch_images * accum * n_data
+    multi = max(1, cfg.train.multi_step_dispatch)
+    if multi > 1 and len(loader) % multi:
+        logger.warning(
+            "multi_step_dispatch=%d drops %d trailing batch(es) per epoch "
+            "(loader yields %d)", multi, len(loader) % multi, len(loader))
+    batch_size = cfg.train.batch_images * accum * n_data * multi
     speedometer = Speedometer(batch_size, frequent)
 
     # Async epoch-end saves (train/checkpoint.py CheckpointWriter); the
@@ -202,9 +224,10 @@ def fit_detector(
     try:
         for epoch in range(begin_epoch, end_epoch):
             bag = MetricBag()
-            for i, batch in enumerate(loader):
+            for i, batch in enumerate(_dispatch_batches(loader, multi)):
                 rng, k = jax.random.split(rng)
-                state, metrics = step_fn(state, shard_batch(batch, mesh), k)
+                state, metrics = step_fn(
+                    state, shard_batch(batch, mesh, stacked=multi > 1), k)
                 bag.update(metrics)
                 speedometer(epoch, i, bag)
             logger.info("Epoch[%d] done. %s", epoch, bag.format())

@@ -156,24 +156,39 @@ def load_checkpoint(prefix: str, epoch: int, *, template=None,
                 else template}
         if opt_state_template is not None and _has_opt_state(path):
             item["opt_state"] = opt_state_template
-    if item is not None and "opt_state" not in item and _has_opt_state(path):
-        # Inference-time load of a training checkpoint: restore params only,
-        # skipping the saved opt_state (orbax rejects the structure mismatch
-        # otherwise). partial_restore needs orbax >= 0.5.21; older versions
-        # raise TypeError on the kwarg — fall back to restoring the params
-        # subtree directly from its subdirectory.
+
+    def _params_only(item):
+        # Restore params while SKIPPING an on-disk opt_state (inference
+        # load, or an opt_state from an older optimizer layout): orbax
+        # rejects the structure mismatch of a plain restore, so this must
+        # go through partial_restore. That kwarg needs orbax >= 0.5.21;
+        # older versions raise TypeError — fall back to an untyped full
+        # restore (flax params are plain dicts, so dropping the template
+        # only loses dtype coercion).
+        item = {"params": item["params"]}
         try:
-            restored = ckptr.restore(
+            return ckptr.restore(
                 path, args=ocp.args.PyTreeRestore(item=item,
                                                   partial_restore=True))
         except TypeError:
-            # Untyped full restore of the whole checkpoint (including the
-            # opt_state, which is discarded): flax params are plain dicts,
-            # so dropping the item template only loses dtype coercion —
-            # acceptable for the legacy-orbax inference path.
-            restored = {"params": ckptr.restore(path)["params"]}
+            return {"params": ckptr.restore(path)["params"]}
+
+    if item is not None and "opt_state" not in item and _has_opt_state(path):
+        restored = _params_only(item)
     else:
-        restored = ckptr.restore(path, item=item)
+        try:
+            restored = ckptr.restore(path, item=item)
+        except Exception:
+            if item is not None and "opt_state" in item:
+                # Saved opt_state from an older optimizer layout — restore
+                # params only; the caller rebuilds the schedule via
+                # begin_step.
+                logger.warning(
+                    "opt_state in %s does not match the current optimizer "
+                    "layout; restoring params only", path)
+                restored = _params_only(item)
+            else:
+                raise
     params = restored["params"]
     if num_classes is not None:
         params = renormalize_bbox_params(params, means, stds, num_classes)

@@ -111,14 +111,20 @@ def build_optimizer(cfg: Config, params, steps_per_epoch: int = 1000,
         raise ValueError(
             f"train.optimizer must be 'sgd' or 'adamw', got "
             f"{cfg.train.optimizer!r}")
-    # NOT optax.masked(inner, mask): masked() passes the RAW GRADIENT
-    # through for masked-out leaves (optax's contract), which apply_updates
-    # would then ADD to the frozen params — gradient ascent. Harmless only
-    # when the frozen grads are structurally zero (the stop_gradient-cut C4
-    # prefix), actively wrong for the alternate-training frozen-trunk
-    # stages where grads through `features` are real. Frozen leaves must
-    # get a hard zero update (caught by test_stages.py's trunk-sharing
+    # Freezing is a HARD ZERO on the update, not optax.masked: masked()
+    # passes the RAW GRADIENT through for masked-out leaves (optax's
+    # contract), which apply_updates would then ADD to the frozen params —
+    # gradient ascent. Harmless only when the frozen grads are
+    # structurally zero (the stop_gradient-cut C4 prefix), actively wrong
+    # for the alternate-training frozen-trunk stages where grads through
+    # `features` are real (caught by test_stages.py's trunk-sharing
     # assertion).
+    # One code path for DP and TP. Alternatives were measured on-chip and
+    # REJECTED (r4, PERF.md): optax.flatten (one big vector) costs 10.2 ms
+    # vs this chain's 6.1 — the ravel/unravel are ~300 slice ops each
+    # way; a hand-fused one-kernel-per-leaf SGD measures 6.46 ms — the
+    # update is HBM-traffic-bound (~1.2 GB/step at f32), not
+    # kernel-count-bound, so the chain is already at its floor.
     labels = jax.tree_util.tree_map(
         lambda t: "train" if t else "frozen", mask)
     return optax.multi_transform(

@@ -137,12 +137,15 @@ def test_end2end_vitdet_overfit_and_eval(tmp_path):
 def test_end2end_detr_overfit_and_eval(tmp_path):
     """DETR (stretch config 5) convergence gate.
 
-    Calibration (scratch probe, seed 0, AdamW preset lr 1e-4): the loss
-    falls 10.7 → ~2.5 over 150 epochs and mAP reaches 0.38-0.65 from
-    epoch ~120 (eval noise is high for a 20-query DETR on 8 images —
-    set-prediction is the slowest-converging family, Carion et al. §4).
-    Bars: mAP > 0.25 (weakest late-probe eval 0.38) AND final loss <
-    0.4 × first (probed 0.23) — a non-learning DETR fails both.
+    Recalibrated r4 (VERDICT r3 item 7: the old 8-image/150-epoch gate's
+    mAP>0.25 bar was soft — probed evals wobbled 0.38–0.65). Probe on the
+    SMALLER 4-image/1-object fixture (scratch_probe/detr_gate_probe.py,
+    seed 0, AdamW preset lr 1e-4): mAP reaches **1.0 by epoch 25 and
+    holds 1.0 through 150** (sampled every 25), loss 11.13 → 1.16 (ratio
+    0.105). Gate: 100 epochs (4× the convergence point), bars mAP > 0.7
+    AND final loss < 0.4 × first — a half-working matcher/loss cannot
+    hold 0.7 on a fixture a correct model pins at 1.0, and the gate is
+    ~4× cheaper than the old one (400 vs 1200 steps).
     NOTE: lr 3e-4+ plateaus at loss ~10.4 forever; the preset lr is
     load-bearing."""
     cfg = generate_config("detr_r50", "synthetic", **{
@@ -161,14 +164,15 @@ def test_end2end_detr_overfit_and_eval(tmp_path):
         "test.max_per_image": 8,
     })
     # the paper-schedule preset: adamw 1e-4, drop at epoch 200 (so the
-    # 150-epoch gate trains at constant lr without overrides)
+    # gate trains at constant lr without overrides)
     assert cfg.train.optimizer == "adamw" and cfg.train.lr == 1e-4
     assert cfg.train.lr_step == (200,)
-    ds = _dataset()
+    ds = SyntheticDataset("train", num_images=4, image_size=128,
+                          max_objects=1, min_size_frac=4, max_size_frac=2)
     roidb = ds.gt_roidb()
     history = []
     params = fit_detector(
-        cfg, roidb, prefix=str(tmp_path / "ckpt"), end_epoch=150,
+        cfg, roidb, prefix=str(tmp_path / "ckpt"), end_epoch=100,
         frequent=10000, seed=0, checkpoint_period=50,
         epoch_callback=lambda e, s, b: history.append(
             b.get()["TotalLoss"]))
@@ -176,7 +180,7 @@ def test_end2end_detr_overfit_and_eval(tmp_path):
     model = zoo.build_model(cfg)
     result = pred_eval(Predictor(model, params, cfg),
                        TestLoader(roidb, cfg, batch_size=1), ds, thresh=0.05)
-    assert result["mAP"] > 0.25, result
+    assert result["mAP"] > 0.7, result
 
 
 @pytest.mark.slow

@@ -227,19 +227,23 @@ def test_fit_detector_pp_smoke(tmp_path, rng):
     assert (tmp_path / "pp" / "0001").exists()
 
 
-def test_sequential_to_staged_checkpoint_conversion(rng):
+@pytest.mark.parametrize("stages_n", [2, 4])
+def test_sequential_to_staged_checkpoint_conversion(rng, stages_n):
     """A sequentially-trained ViTDet param tree converts to the staged/PP
-    layout with identical numerics (and back, bit-exact round trip)."""
+    layout with identical numerics (and back, bit-exact round trip) for
+    EVERY supported stage count — the staged model preserves the
+    sequential global-attention placement (depth 8: globals {1,3,5,7} →
+    in-stage {1,3} per half at stages_n=2, {1} per quarter at 4)."""
     from mx_rcnn_tpu.models.vit import (
         sequential_to_staged, staged_to_sequential)
 
     cfg_seq = _vit_pp_cfg(pp_stages=0, **{"network.vit_depth": 8,
                                           "train.batch_images": 1})
-    cfg_pp = _vit_pp_cfg(pp_stages=4, **{"network.vit_depth": 8,
-                                         "train.batch_images": 1})
+    cfg_pp = _vit_pp_cfg(pp_stages=stages_n, **{"network.vit_depth": 8,
+                                                "train.batch_images": 1})
     model_seq = zoo.build_model(cfg_seq)
     params_seq = zoo.init_params(model_seq, cfg_seq, jax.random.PRNGKey(0))
-    staged = sequential_to_staged(params_seq, 4)
+    staged = sequential_to_staged(params_seq, stages_n)
 
     model_pp = zoo.build_model(cfg_pp)  # no mesh: sequential staged exec
     batch = _batch(rng, b=1)
@@ -263,9 +267,10 @@ def test_sequential_to_staged_rejects_mismatched_layout(rng):
                                           "train.batch_images": 1})
     model_seq = zoo.build_model(cfg_seq)
     params_seq = zoo.init_params(model_seq, cfg_seq, jax.random.PRNGKey(0))
-    # 2 stages over depth 8: tails {3,7} != sequential globals {1,3,5,7}.
-    with pytest.raises(ValueError, match="stage tails"):
-        sequential_to_staged(params_seq, 2)
+    # 8 stages over depth 8 (per=1): sequential globals {1,3,5,7} give
+    # alternating empty/global per-stage patterns — not preservable.
+    with pytest.raises(ValueError, match="preserve"):
+        sequential_to_staged(params_seq, 8)
     with pytest.raises(ValueError, match="divide"):
         sequential_to_staged(params_seq, 3)
     # Wrong tree kind, both directions.
@@ -274,11 +279,20 @@ def test_sequential_to_staged_rejects_mismatched_layout(rng):
             sequential_to_staged(params_seq, 4), 4)
     with pytest.raises(ValueError, match="staged-backbone"):
         staged_to_sequential(params_seq)
-    # pp_stages=2-shaped staged tree (tails {1,3} over depth 4 with
-    # per=2): Block shapes would LOAD cleanly into the sequential model —
-    # the converter must reject on architecture, not shape.
-    cfg_pp2 = _vit_pp_cfg(pp_stages=2, **{"train.batch_images": 1})
-    staged2 = zoo.init_params(zoo.build_model(cfg_pp2), cfg_pp2,
-                              jax.random.PRNGKey(0))
+    # Hand-built stages_n=8/per=1 staged tree over depth 8: Block shapes
+    # would LOAD cleanly into the sequential model — the converter must
+    # reject on architecture (alternating placement), not shape.
+    feats = params_seq["params"]["features"]
+    blocks = [feats[f"block{i}"] for i in range(8)]
+    bad = {
+        **params_seq,
+        "params": {
+            **params_seq["params"],
+            "features": {
+                k: v for k, v in feats.items() if not k.startswith("block")
+            } | {"stages": {"b0": jax.tree.map(
+                lambda *xs: jnp.stack(xs), *blocks)}},
+        },
+    }
     with pytest.raises(ValueError, match="architectures differ"):
-        staged_to_sequential(staged2)
+        staged_to_sequential(bad)

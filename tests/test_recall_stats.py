@@ -1,0 +1,145 @@
+"""Proposal-recall grading + from-data bbox-target statistics.
+
+Reference surface: rcnn/dataset/imdb.py::evaluate_recall (driven by
+tools/test_rpn.py) and rcnn/processing/bbox_regression.py::
+add_bbox_regression_targets (the BBOX_NORMALIZATION_PRECOMPUTED=False
+branch).
+"""
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data.datasets import dataset_from_config
+from mx_rcnn_tpu.targets.bbox_stats import (
+    compute_bbox_stats,
+    resolve_bbox_stats,
+)
+
+
+def _ds():
+    cfg = generate_config("resnet50", "synthetic")
+    return dataset_from_config(cfg.dataset)
+
+
+def test_evaluate_recall_exact_counts():
+    ds = _ds()
+    roidb = [{
+        "boxes": np.asarray([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32),
+        "gt_classes": np.asarray([1, 2], np.int32),
+    }]
+    # Top-scored proposal covers gt2 only; second covers gt1.
+    props = [np.asarray([[20, 20, 30, 30, 0.9], [0, 0, 10, 10, 0.8]],
+                        np.float32)]
+    r = ds.evaluate_recall(roidb, props, at=(1, 2))
+    assert r["recall@1"] == pytest.approx(0.5)
+    assert r["recall@2"] == pytest.approx(1.0)
+    assert r["num_gt"] == 2.0 and r["num_proposals"] == 2.0
+
+
+def test_evaluate_recall_resorts_by_score_column():
+    ds = _ds()
+    roidb = [{
+        "boxes": np.asarray([[0, 0, 10, 10]], np.float32),
+        "gt_classes": np.asarray([1], np.int32),
+    }]
+    # Mis-ordered dump: covering proposal carries the HIGHER score but
+    # sits second — the score column must drive the top-N cut.
+    props = [np.asarray([[50, 50, 60, 60, 0.2], [0, 0, 10, 10, 0.9]],
+                        np.float32)]
+    r = ds.evaluate_recall(roidb, props, at=(1,))
+    assert r["recall@1"] == pytest.approx(1.0)
+
+
+def test_evaluate_recall_greedy_one_to_one():
+    """One proposal overlapping TWO clustered gts counts one covered gt
+    (reference greedy matching removes the proposal after its first
+    match), not two."""
+    ds = _ds()
+    roidb = [{
+        # Two overlapping gts, both IoU >= 0.5 with the single proposal.
+        "boxes": np.asarray([[0, 0, 99, 99], [0, 20, 99, 119]],
+                            np.float32),
+        "gt_classes": np.asarray([1, 1], np.int32),
+    }]
+    props = [np.asarray([[0, 10, 99, 109]], np.float32)]
+    r = ds.evaluate_recall(roidb, props, at=(1,), iou_thresh=0.5)
+    assert r["recall@1"] == pytest.approx(0.5)  # 1 of 2 gt covered
+
+
+def test_evaluate_recall_iou_threshold():
+    ds = _ds()
+    roidb = [{
+        "boxes": np.asarray([[0, 0, 99, 99]], np.float32),
+        "gt_classes": np.asarray([1], np.int32),
+    }]
+    # Half-overlap proposal: IoU = 50x100 / (100x100 + 50x100 - 50x100)
+    # = 0.5 (with +1 widths: just under/over depending on rounding) —
+    # passes at 0.4, fails at 0.7.
+    props = [np.asarray([[0, 0, 49, 99]], np.float32)]
+    assert ds.evaluate_recall(roidb, props, at=(1,),
+                              iou_thresh=0.4)["recall@1"] == 1.0
+    assert ds.evaluate_recall(roidb, props, at=(1,),
+                              iou_thresh=0.7)["recall@1"] == 0.0
+
+
+def test_compute_bbox_stats_matches_manual_targets():
+    gt = np.asarray([[10, 10, 50, 50]], np.float32)
+    props = np.asarray([[12, 8, 54, 48], [8, 12, 46, 54]], np.float32)
+    roidb = [{"boxes": gt, "gt_classes": np.asarray([1], np.int32),
+              "proposals": props}]
+    means, stds = compute_bbox_stats(roidb, fg_overlap=0.5)
+
+    def t(ex, g):
+        ew, eh = ex[2] - ex[0] + 1, ex[3] - ex[1] + 1
+        gw, gh = g[2] - g[0] + 1, g[3] - g[1] + 1
+        ecx, ecy = ex[0] + 0.5 * (ew - 1), ex[1] + 0.5 * (eh - 1)
+        gcx, gcy = g[0] + 0.5 * (gw - 1), g[1] + 0.5 * (gh - 1)
+        return np.asarray([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                           np.log(gw / ew), np.log(gh / eh)])
+
+    targets = np.stack([t(p, gt[0]) for p in props])
+    np.testing.assert_allclose(means, targets.mean(0), atol=1e-6)
+    np.testing.assert_allclose(stds, targets.std(0), atol=1e-3)
+
+
+def test_compute_bbox_stats_mirrors_flipped_entries():
+    """A flip-doubled roidb (shared unflipped arrays + flipped=True) must
+    measure the MIRRORED targets for the flipped copies: dx means cancel,
+    matching the distribution training actually consumes."""
+    gt = np.asarray([[10, 10, 50, 50]], np.float32)
+    props = np.asarray([[18, 10, 58, 50], [16, 10, 56, 50]],
+                       np.float32)  # pure +dx offsets
+    base = {"boxes": gt, "gt_classes": np.asarray([1], np.int32),
+            "proposals": props, "width": 100, "height": 60}
+    flipped = dict(base, flipped=True)
+    means_half, _ = compute_bbox_stats([base], fg_overlap=0.5)
+    assert abs(means_half[0]) > 0.1  # unflipped alone: biased dx
+    means, _ = compute_bbox_stats([base, flipped], fg_overlap=0.5)
+    assert abs(means[0]) < 1e-6  # mirrored pair cancels dx
+    assert abs(means[1] - means_half[1]) < 1e-6  # dy unaffected
+
+
+def test_compute_bbox_stats_empty_falls_back():
+    means, stds = compute_bbox_stats([], fg_overlap=0.5)
+    assert means == (0.0, 0.0, 0.0, 0.0)
+    assert stds == (0.1, 0.1, 0.2, 0.2)
+
+
+def test_resolve_bbox_stats_precomputed_switch():
+    cfg = generate_config("resnet50", "synthetic")
+    gt = np.asarray([[10, 10, 60, 90]], np.float32)
+    roidb = [{"boxes": gt, "gt_classes": np.asarray([1], np.int32),
+              "proposals": np.asarray([[12, 12, 62, 88]], np.float32)}] * 4
+    # Default: precomputed constants untouched.
+    assert resolve_bbox_stats(cfg, roidb) is cfg
+    # From-data branch: stats land in cfg.train (and thus flow into the
+    # in-graph normalization and the checkpoint contract).
+    from dataclasses import replace
+
+    cfg2 = cfg.with_updates(train=replace(
+        cfg.train, bbox_normalization_precomputed=False))
+    out = resolve_bbox_stats(cfg2, roidb)
+    assert out.train.bbox_means != cfg.train.bbox_means
+    assert all(np.isfinite(out.train.bbox_means))
+    assert all(s > 0 for s in out.train.bbox_stds)

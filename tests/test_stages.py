@@ -59,8 +59,14 @@ def test_generate_then_roiiter_contract(tmp_path):
     params = zoo.init_params(zoo.build_model(cfg), cfg, jax.random.PRNGKey(0))
 
     rpn_file = str(tmp_path / "props.pkl")
-    files = stages.test_rpn_generate(cfg, params, rpn_file)
+    files, recalls = stages.test_rpn_generate(cfg, params, rpn_file)
     assert files == [rpn_file]
+    # Recall grading runs alongside the dump (reference: test_rpn.py →
+    # imdb.evaluate_recall). Fresh-init RPN → any finite value in [0, 1].
+    assert len(recalls) == 1
+    for n in (300, 1000, 2000):
+        assert 0.0 <= recalls[0][f"recall@{n}"] <= 1.0
+    assert recalls[0]["num_gt"] > 0
 
     with open(rpn_file, "rb") as f:
         dumped = pickle.load(f)

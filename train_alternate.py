@@ -53,7 +53,8 @@ def alternate_train(cfg, prefix, rpn_epoch, rcnn_epoch, mesh_spec="",
     rpn1 = train_rpn(cfg, f"{prefix}_rpn1", end_epoch=rpn_epoch,
                      mesh_spec=mesh_spec, frequent=frequent)
     logger.info("=== stage 2: generate stage-1 proposals ===")
-    test_rpn_generate(cfg, rpn1, f"{prefix}_rpn1_proposals.pkl")
+    _, recalls1 = test_rpn_generate(cfg, rpn1, f"{prefix}_rpn1_proposals.pkl")
+    logger.info("stage-1 RPN proposal recall: %s", recalls1)
     logger.info("=== stage 3: train Fast R-CNN ===")
     rcnn1 = train_rcnn(cfg, f"{prefix}_rcnn1", f"{prefix}_rpn1_proposals.pkl",
                        end_epoch=rcnn_epoch, mesh_spec=mesh_spec,
@@ -63,7 +64,8 @@ def alternate_train(cfg, prefix, rpn_epoch, rcnn_epoch, mesh_spec="",
                      end_epoch=rpn_epoch, frozen_trunk=True,
                      mesh_spec=mesh_spec, frequent=frequent)
     logger.info("=== stage 5: generate stage-2 proposals ===")
-    test_rpn_generate(cfg, rpn2, f"{prefix}_rpn2_proposals.pkl")
+    _, recalls2 = test_rpn_generate(cfg, rpn2, f"{prefix}_rpn2_proposals.pkl")
+    logger.info("stage-2 RPN proposal recall: %s", recalls2)
     logger.info("=== stage 6: re-train Fast R-CNN, trunk frozen ===")
     rcnn2 = train_rcnn(cfg, f"{prefix}_rcnn2", f"{prefix}_rpn2_proposals.pkl",
                        pretrained_params=rpn2, end_epoch=rcnn_epoch,
