@@ -88,9 +88,18 @@ def _load_roidb_entry(entry: Dict, cfg: Config, scale_idx: int = 0,
     img, scale = resize_image(img, target, max_size)
     boxes *= scale
     h, w = img.shape[:2]
-    img = transform_image(img, cfg.image.pixel_means, cfg.image.pixel_stds)
-    img = pad_image(img, pad if pad is not None
-                    else pad_shape_for(cfg, scale_idx))
+    pad = pad if pad is not None else pad_shape_for(cfg, scale_idx)
+    # Fused GIL-free normalize+pad (cc/imgproc.c); numpy fallback.
+    from mx_rcnn_tpu.data._native_img import normalize_pad
+
+    fused = normalize_pad(np.ascontiguousarray(img, np.float32),
+                          cfg.image.pixel_means, cfg.image.pixel_stds, pad)
+    if fused is not None:
+        img = fused
+    else:
+        img = pad_image(
+            transform_image(img, cfg.image.pixel_means,
+                            cfg.image.pixel_stds), pad)
     im_info = np.asarray([h, w, scale], np.float32)
     return img, im_info, boxes, entry["gt_classes"].astype(np.int32)
 

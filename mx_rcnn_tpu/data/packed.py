@@ -159,16 +159,25 @@ def load_packed_entry(entry: Dict, cfg: Config, scale_idx: int,
     img_u8 = np.asarray(_shard_mmap(entry["packed_file"])
                         [entry["packed_index"], :rh, :rw])
     boxes = entry["boxes"].astype(np.float32).copy()
-    if entry.get("flipped"):
-        img_u8 = img_u8[:, ::-1]
+    flipped = bool(entry.get("flipped"))
+    if flipped:
         w0 = entry["width"]
         x1 = boxes[:, 0].copy()
         boxes[:, 0] = w0 - boxes[:, 2] - 1
         boxes[:, 2] = w0 - x1 - 1
     boxes *= scale
-    img = transform_image(img_u8.astype(np.float32),
-                          cfg.image.pixel_means, cfg.image.pixel_stds)
-    img = pad_image(img, pad if pad is not None
-                    else pad_shape_for(cfg, scale_idx))
+    pad = pad if pad is not None else pad_shape_for(cfg, scale_idx)
+    # Fused GIL-free mirror+normalize+pad (cc/imgproc.c) with the numpy
+    # chain as fallback.
+    from mx_rcnn_tpu.data._native_img import normalize_pad
+
+    img = normalize_pad(img_u8, cfg.image.pixel_means,
+                        cfg.image.pixel_stds, pad, flip=flipped)
+    if img is None:
+        arr = img_u8[:, ::-1] if flipped else img_u8
+        img = pad_image(
+            transform_image(arr.astype(np.float32),
+                            cfg.image.pixel_means, cfg.image.pixel_stds),
+            pad)
     im_info = np.asarray([rh, rw, scale], np.float32)
     return img, im_info, boxes, entry["gt_classes"].astype(np.int32)

@@ -13,10 +13,12 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
+import threading
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+from mx_rcnn_tpu.utils.native_build import build_and_load
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -25,36 +27,10 @@ _SO = os.path.join(_REPO, "cc", "build", "libmaskapi.so")
 
 _lib = None
 _tried = False
+_init_lock = threading.Lock()
 
 
-def _build() -> Optional[str]:
-    if not os.path.exists(_SRC):
-        return None
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    for cc in ("cc", "gcc", "clang"):
-        try:
-            subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
-                check=True, capture_output=True, timeout=120)
-            return _SO
-        except (OSError, subprocess.SubprocessError):
-            continue
-    return None
-
-
-def get_lib():
-    """The loaded CDLL, building it if needed; None if unavailable."""
-    global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
-    _tried = True
-    path = _SO if os.path.exists(_SO) else _build()
-    if path is None:
-        return None
-    try:
-        lib = ctypes.CDLL(path)
-    except OSError:
-        return None
+def _bind(lib):
     u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
@@ -72,7 +48,21 @@ def get_lib():
     lib.rle_iou.argtypes = [u32p, i64p, i64p, ctypes.c_long,
                             u32p, i64p, i64p, ctypes.c_long,
                             u8p, f64p]
-    _lib = lib
+
+
+def get_lib():
+    """The loaded CDLL, building it if needed; None if unavailable.
+
+    Build/load/staleness/race handling lives in utils/native_build.py
+    (shared with data/_native_img.py).
+    """
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _init_lock:
+        if _lib is None and not _tried:
+            _lib = build_and_load(_SRC, _SO, _bind)
+            _tried = True
     return _lib
 
 

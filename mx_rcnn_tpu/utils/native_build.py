@@ -1,0 +1,84 @@
+"""Shared build-on-first-use machinery for the C accelerator kernels.
+
+One place for the concerns both ctypes bridges (masks/_native.py,
+data/_native_img.py) need:
+
+- build with the system compiler into a TEMP file and atomically rename —
+  concurrent first-use builds (loader worker threads start immediately)
+  cannot interleave writes into a corrupt .so that would permanently
+  disable the native path;
+- a process-wide lock around the build/load bootstrap;
+- staleness: rebuild when the source is newer than the .so (an edited
+  kernel with a stale artifact would otherwise run old code or blow up
+  on a missing symbol);
+- load failures of ANY kind return None — callers keep their numpy
+  fallback, the native layer is a pure accelerator, never a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+
+
+def _build(src: str, so: str) -> Optional[str]:
+    os.makedirs(os.path.dirname(so), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(so))
+    os.close(fd)
+    try:
+        for cc in ("cc", "gcc", "clang"):
+            try:
+                subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)  # atomic: readers see old or new
+                return so
+            except (OSError, subprocess.SubprocessError):
+                continue
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def build_and_load(src: str, so: str, bind) -> Optional[ctypes.CDLL]:
+    """Return the bound CDLL for ``src`` (building/rebuilding ``so`` as
+    needed), or None when the toolchain/artifact is unusable.
+
+    ``bind(lib)`` declares restype/argtypes for every symbol; if it
+    raises (stale .so missing a symbol), the library is rebuilt once
+    from source before giving up.
+    """
+    with _LOCK:
+        if not os.path.exists(src):
+            return None
+
+        def fresh(path: str) -> bool:
+            try:
+                return os.path.getmtime(path) >= os.path.getmtime(src)
+            except OSError:
+                return False
+
+        path = so if fresh(so) else _build(src, so)
+        if path is None:
+            return None
+        for attempt in (0, 1):
+            try:
+                lib = ctypes.CDLL(path)
+                bind(lib)
+                return lib
+            except (OSError, AttributeError):
+                if attempt == 0:  # corrupt or stale artifact: rebuild once
+                    path = _build(src, so)
+                    if path is None:
+                        return None
+        return None
