@@ -73,7 +73,7 @@ def _load_roidb_entry(entry: Dict, cfg: Config, scale_idx: int = 0,
 
     Packed entries (data/packed.py shards) take the mmap fast path: the
     decode+resize already happened at pack time."""
-    if "packed_file" in entry:
+    if "packed" in entry:
         from mx_rcnn_tpu.data.packed import load_packed_entry
 
         return load_packed_entry(entry, cfg, scale_idx, pad)

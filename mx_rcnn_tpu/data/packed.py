@@ -8,17 +8,22 @@ ancestor); this is the TPU-era replacement: decode and resize ONCE at pack
 time, then train-time loading is an mmap slice + normalize + pad.
 
 Format (one directory):
-  shard_{k:04d}.npy   (N, Hb, Wb, 3) uint8 RGB, mmap-able; every image is
-                      resized to the packed scale and zero-padded to its
-                      ORIENTED pad bucket (landscape/portrait shards are
-                      packed separately so rows are uniform).
-  manifest.pkl        per-image dicts: shard path/row, resized (rh, rw),
-                      scale, original roidb gt fields (boxes in ORIGINAL
+  s{j}_shard_{k:04d}.npy  (N, Hb, Wb, 3) uint8 RGB, mmap-able; every image
+                      is resized to training scale j and zero-padded to
+                      its ORIENTED pad bucket (landscape/portrait shards
+                      are packed separately so rows are uniform). One
+                      shard set per cfg.image.scales entry — multi-scale
+                      training draws a scale per batch and reads the
+                      matching set.
+  manifest.pkl        ONE dict per image: a `packed` map
+                      {scale_idx: {file, index, hw, scale}} plus the
+                      original roidb gt fields (boxes in ORIGINAL
                       coordinates, gt_classes, segmentations/gt_masks...).
 
-`load_packed_roidb(dir)` returns a normal roidb whose entries carry
-packed_* keys; data/loader.py::_load_roidb_entry takes the mmap fast path
-for them — same AnchorLoader/ROIIter API, same batches, no other changes.
+`load_packed_roidb(dir)` returns a normal roidb whose entries carry the
+`packed` scale map; data/loader.py::_load_roidb_entry takes the mmap fast
+path for them — same AnchorLoader/ROIIter API, same batches, no other
+changes.
 """
 
 from __future__ import annotations
@@ -45,9 +50,13 @@ def _oriented_bucket(cfg: Config, scale_idx: int, landscape: bool) -> tuple:
 
 
 def write_packed_dataset(roidb: List[Dict], cfg: Config, out_dir: str,
-                         scale_idx: int = 0,
+                         scale_idx=None,
                          shard_images: int = 512) -> str:
-    """Decode+resize every roidb image once and write packed shards.
+    """Decode every roidb image once and write packed shards for EVERY
+    training scale (multi-scale configs pack one shard set per
+    cfg.image.scales entry — the loader draws a scale per batch and reads
+    the matching set). scale_idx: an int or list restricts the packed
+    scales (single-scale fixtures, tests).
 
     Only UNFLIPPED entries are packed (flip is a view at load time —
     append_flipped_images after load_packed_roidb works as usual).
@@ -55,8 +64,12 @@ def write_packed_dataset(roidb: List[Dict], cfg: Config, out_dir: str,
     from mx_rcnn_tpu.data.image import load_image, resize_image
 
     os.makedirs(out_dir, exist_ok=True)
-    target, max_size = cfg.image.scales[scale_idx]
-    manifest: List[Dict] = []
+    if scale_idx is None:
+        scale_ids = list(range(len(cfg.image.scales)))
+    elif isinstance(scale_idx, int):
+        scale_ids = [scale_idx]
+    else:
+        scale_ids = [int(s) for s in scale_idx]
     # Group by orientation so every shard has uniform row shape.
     by_orient = {True: [], False: []}
     for i, entry in enumerate(roidb):
@@ -67,62 +80,110 @@ def write_packed_dataset(roidb: List[Dict], cfg: Config, out_dir: str,
         landscape = entry.get("width", 1) >= entry.get("height", 1)
         by_orient[landscape].append(i)
 
-    shard_id = 0
+    # One manifest record per image, carrying every packed scale.
+    recs: Dict[int, Dict] = {}
+    for i, entry in enumerate(roidb):
+        rec = {
+            "packed": {},
+            "height": entry.get("height"),
+            "width": entry.get("width"),
+            "boxes": np.asarray(entry["boxes"], np.float32),
+            "flipped": False,
+        }
+        for k in _GT_KEYS:
+            if k in entry:
+                rec[k] = entry[k]
+        recs[i] = rec
+
+    # Scale is the INNER loop: each image decodes ONCE and feeds every
+    # per-scale shard row from that decode (JPEG decode is the cost this
+    # format exists to amortize — a scale-outer loop would multiply it).
+    n_shards = 0
     for landscape, idxs in by_orient.items():
-        bucket = _oriented_bucket(cfg, scale_idx, landscape)
+        shard_id = 0
         for lo in range(0, len(idxs), shard_images):
             chunk = idxs[lo:lo + shard_images]
-            arr = np.zeros((len(chunk), *bucket, 3), np.uint8)
-            rows = []
+            arrs = {s: np.zeros(
+                (len(chunk), *_oriented_bucket(cfg, s, landscape), 3),
+                np.uint8) for s in scale_ids}
             for row, i in enumerate(chunk):
                 entry = roidb[i]
                 img = (entry["image_data"].astype(np.float32)
                        if "image_data" in entry
                        else load_image(entry["image"]))
-                img, scale = resize_image(img, target, max_size)
-                rh, rw = img.shape[:2]
-                if rh > bucket[0] or rw > bucket[1]:
-                    raise ValueError(
-                        f"resized image ({rh},{rw}) exceeds pad bucket "
-                        f"{bucket} — check image.scales/pad_shapes")
-                arr[row, :rh, :rw] = np.clip(np.rint(img), 0,
-                                             255).astype(np.uint8)
-                rows.append((i, rh, rw, float(scale)))
-            path = os.path.join(out_dir, f"shard_{shard_id:04d}.npy")
-            np.save(path, arr)
-            for row, (i, rh, rw, scale) in enumerate(rows):
-                entry = roidb[i]
-                rec = {
-                    "packed_file": os.path.basename(path),
-                    "packed_index": row,
-                    "packed_hw": (rh, rw),
-                    "packed_scale": scale,
-                    "packed_scale_idx": scale_idx,
-                    "height": entry.get("height"),
-                    "width": entry.get("width"),
-                    "boxes": np.asarray(entry["boxes"], np.float32),
-                    "flipped": False,
-                }
-                for k in _GT_KEYS:
-                    if k in entry:
-                        rec[k] = entry[k]
-                manifest.append(rec)
+                for s in scale_ids:
+                    target, max_size = cfg.image.scales[s]
+                    rimg, scale = resize_image(img, target, max_size)
+                    rh, rw = rimg.shape[:2]
+                    bucket = arrs[s].shape[1:3]
+                    if rh > bucket[0] or rw > bucket[1]:
+                        raise ValueError(
+                            f"resized image ({rh},{rw}) exceeds pad "
+                            f"bucket {bucket} — check image.scales/"
+                            "pad_shapes")
+                    arrs[s][row, :rh, :rw] = np.clip(
+                        np.rint(rimg), 0, 255).astype(np.uint8)
+                    recs[i]["packed"][s] = {
+                        "file": f"s{s}_shard_{shard_id:04d}_"
+                                f"{'l' if landscape else 'p'}.npy",
+                        "index": row, "hw": (rh, rw),
+                        "scale": float(scale),
+                    }
+            for s in scale_ids:
+                np.save(os.path.join(
+                    out_dir, f"s{s}_shard_{shard_id:04d}_"
+                             f"{'l' if landscape else 'p'}.npy"), arrs[s])
+                n_shards += 1
             shard_id += 1
+    manifest = {
+        # Pack-time geometry: load_packed_roidb validates it against the
+        # training config so a pack made for another network/resolution
+        # fails loudly instead of training at the wrong scale.
+        "meta": {
+            "scales": tuple(cfg.image.scales),
+            "pad_shapes": tuple(cfg.image.pad_shapes),
+            "pad_shape": tuple(cfg.image.pad_shape),
+            "scale_ids": scale_ids,
+        },
+        "records": [recs[i] for i in range(len(roidb))],
+    }
     mpath = os.path.join(out_dir, "manifest.pkl")
     with open(mpath, "wb") as f:
         pickle.dump(manifest, f, pickle.HIGHEST_PROTOCOL)
-    logger.info("packed %d images into %d shards under %s",
-                len(manifest), shard_id, out_dir)
+    logger.info("packed %d images x %d scale(s) into %d shards under %s",
+                len(recs), len(scale_ids), n_shards, out_dir)
     return mpath
 
 
-def load_packed_roidb(out_dir: str) -> List[Dict]:
-    """Manifest → roidb (entries carry packed_* keys; paths resolved)."""
+def load_packed_roidb(out_dir: str, cfg: Optional[Config] = None
+                      ) -> List[Dict]:
+    """Manifest → roidb (entries carry the `packed` scale map; shard
+    paths resolved). With ``cfg``, the pack-time image geometry is
+    validated against the training config — a shard set packed for a
+    different network/resolution fails here, loudly, instead of silently
+    training at the wrong scale."""
     with open(os.path.join(out_dir, "manifest.pkl"), "rb") as f:
         manifest = pickle.load(f)
-    for rec in manifest:
-        rec["packed_file"] = os.path.join(out_dir, rec["packed_file"])
-    return manifest
+    if not isinstance(manifest, dict) or "records" not in manifest:
+        raise ValueError(
+            f"{out_dir} holds a pre-multi-scale packed manifest (or not a "
+            "packed dataset); re-pack with tools/pack_dataset.py")
+    if cfg is not None:
+        meta = manifest["meta"]
+        want = {"scales": tuple(cfg.image.scales),
+                "pad_shapes": tuple(cfg.image.pad_shapes),
+                "pad_shape": tuple(cfg.image.pad_shape)}
+        have = {k: tuple(meta[k]) for k in want}
+        if want != have:
+            raise ValueError(
+                f"packed dataset geometry {have} does not match the "
+                f"training config {want}; re-pack with the same "
+                "network/image settings (tools/pack_dataset.py)")
+    records = manifest["records"]
+    for rec in records:
+        for s in rec["packed"].values():
+            s["file"] = os.path.join(out_dir, os.path.basename(s["file"]))
+    return records
 
 
 # -- load-time fast path (called from data/loader.py) -----------------------
@@ -149,15 +210,15 @@ def load_packed_entry(entry: Dict, cfg: Config, scale_idx: int,
     from mx_rcnn_tpu.data.image import pad_image, transform_image
     from mx_rcnn_tpu.data.loader import pad_shape_for
 
-    if scale_idx != entry["packed_scale_idx"]:
+    ref = entry["packed"].get(scale_idx)
+    if ref is None:
         raise ValueError(
-            f"packed at scale_idx {entry['packed_scale_idx']} but batch "
-            f"drew scale_idx {scale_idx}; pack every training scale or "
-            "use a single-scale config")
-    rh, rw = entry["packed_hw"]
-    scale = entry["packed_scale"]
-    img_u8 = np.asarray(_shard_mmap(entry["packed_file"])
-                        [entry["packed_index"], :rh, :rw])
+            f"scale_idx {scale_idx} is not packed (have "
+            f"{sorted(entry['packed'])}); re-pack with "
+            "write_packed_dataset covering every training scale")
+    rh, rw = ref["hw"]
+    scale = ref["scale"]
+    img_u8 = np.asarray(_shard_mmap(ref["file"])[ref["index"], :rh, :rw])
     boxes = entry["boxes"].astype(np.float32).copy()
     flipped = bool(entry.get("flipped"))
     if flipped:

@@ -1,0 +1,65 @@
+"""Pack a dataset into pre-decoded shards for the fast input pipeline.
+
+Usage (same dataset/config flags as train_end2end.py):
+
+    python -m mx_rcnn_tpu.tools.pack_dataset --network resnet101_fpn \
+        --dataset coco --image_set train2017 --out data/packed/train2017
+
+then train with ``train_end2end.py ... --packed-dir data/packed/train2017``.
+
+Decode+resize happen ONCE here (every cfg.image.scales entry gets its own
+shard set, so multi-scale recipes work unchanged); train-time loading is
+an mmap slice + one fused native normalize/pad pass — measured 553 img/s
+vs 72 for the per-epoch JPEG path (PERF.md r4). The reference has no
+equivalent (MXNet's im2rec is the closest ancestor).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from mx_rcnn_tpu.config import generate_config, parse_cli_overrides
+from mx_rcnn_tpu.data.packed import write_packed_dataset
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.tools.train import load_gt_roidbs
+
+
+def parse_args():
+    p = argparse.ArgumentParser(
+        description="Pack a dataset into pre-decoded uint8 shards")
+    p.add_argument("--network", default="resnet101",
+                   help="network preset (fixes the training scales/pads)")
+    p.add_argument("--dataset", default="coco")
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--out", required=True, help="output shard directory")
+    p.add_argument("--shard_images", type=int, default=512)
+    p.add_argument("--set", dest="set_cfg", action="append", default=[],
+                   metavar="KEY=VALUE")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    overrides = {}
+    if args.image_set:
+        overrides["dataset.image_set"] = args.image_set
+    if args.root_path:
+        overrides["dataset.root_path"] = args.root_path
+    if args.dataset_path:
+        overrides["dataset.dataset_path"] = args.dataset_path
+    overrides.update(parse_cli_overrides(args.set_cfg))
+    cfg = generate_config(args.network, args.dataset, **overrides)
+
+    # Same multi-set load (and box-less filtering) as the train side —
+    # flip stays off: flipped copies are a load-time view of the pack.
+    roidb = load_gt_roidbs(cfg, flip=False)
+    logger.info("packing %d images at scales %s -> %s", len(roidb),
+                cfg.image.scales, args.out)
+    write_packed_dataset(roidb, cfg, args.out,
+                         shard_images=args.shard_images)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Pack COCO train2017 into pre-decoded shards (one set per training
+# scale), then any train recipe can add --packed-dir to use the fast
+# host input path (553 vs 72 img/s, PERF.md r4). Run get_coco.sh first.
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+NETWORK="${NETWORK:-resnet101_fpn}"   # fixes the scales/pad buckets
+OUT="${OUT:-data/packed/coco_train2017_${NETWORK}}"
+
+JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.pack_dataset \
+  --network "$NETWORK" --dataset coco --image_set train2017 \
+  --out "$OUT" "$@"
+
+echo "train with: train_end2end.py --network $NETWORK --dataset coco \\"
+echo "  --image_set train2017 --packed-dir $OUT ..."

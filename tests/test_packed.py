@@ -114,6 +114,28 @@ def test_packed_through_anchor_loader(tmp_path):
         assert b["gt_valid"].any()
 
 
+def test_packed_multiscale_through_anchor_loader(tmp_path):
+    """A multi-scale config packs one shard set per scale; the loader's
+    per-batch scale draw reads the matching set (the FPN acceptance
+    recipe trains multi-scale)."""
+    cfg = _cfg(**{
+        "image.scales": ((96, 160), (128, 214)),
+        "image.pad_shapes": ((104, 168), (136, 216)),
+        "image.pad_shape": (216, 216),
+        "train.batch_images": 2,
+    })
+    roidb = _jpeg_roidb(tmp_path, n=8)
+    write_packed_dataset(roidb, cfg, str(tmp_path / "pack"))
+    packed = load_packed_roidb(str(tmp_path / "pack"))
+    assert all(sorted(r["packed"]) == [0, 1] for r in packed)
+    shapes = set()
+    for _ in range(4):  # several epochs: both scales get drawn
+        for b in AnchorLoader(packed, cfg, num_shards=1, seed=0):
+            shapes.add(b["image"].shape[1:3])
+            assert np.isfinite(b["image"]).all()
+    assert len(shapes) >= 2, shapes
+
+
 def test_packed_scale_mismatch_raises(tmp_path):
     cfg = _cfg()
     roidb = _jpeg_roidb(tmp_path, n=2)
@@ -121,6 +143,28 @@ def test_packed_scale_mismatch_raises(tmp_path):
     packed = load_packed_roidb(str(tmp_path / "pack"))
     with pytest.raises(ValueError, match="scale_idx"):
         _load_roidb_entry(packed[0], cfg, scale_idx=1)
+
+
+def test_packed_geometry_validation(tmp_path):
+    """Loading with a config whose image geometry differs from pack time
+    must fail loudly (silent wrong-resolution training otherwise)."""
+    cfg = _cfg()
+    roidb = _jpeg_roidb(tmp_path, n=2)
+    write_packed_dataset(roidb, cfg, str(tmp_path / "pack"))
+    assert len(load_packed_roidb(str(tmp_path / "pack"), cfg)) == 2
+    other = _cfg(**{"image.scales": ((96, 160),)})
+    with pytest.raises(ValueError, match="geometry"):
+        load_packed_roidb(str(tmp_path / "pack"), other)
+
+
+def test_packed_old_format_rejected(tmp_path):
+    import pickle
+
+    (tmp_path / "pack").mkdir()
+    with open(tmp_path / "pack" / "manifest.pkl", "wb") as f:
+        pickle.dump([{"packed_file": "x.npy"}], f)  # pre-multi-scale list
+    with pytest.raises(ValueError, match="re-pack"):
+        load_packed_roidb(str(tmp_path / "pack"))
 
 
 def test_packed_rejects_flipped_input(tmp_path):

@@ -58,6 +58,11 @@ def parse_args():
                         "--set network.tensor_parallel=true "
                         "--set train.batch_images=2 (values parsed as "
                         "python literals / bool words, else kept as strings)")
+    p.add_argument("--packed-dir", dest="packed_dir", default=None,
+                   help="train from packed pre-decoded shards written by "
+                        "tools/pack_dataset.py (data/packed.py) instead "
+                        "of decoding JPEGs per epoch — the host "
+                        "input-pipeline fast path (PERF.md r4)")
     return p.parse_args()
 
 
@@ -103,7 +108,18 @@ def main():
             means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
             num_classes=cfg.dataset.num_classes)
 
-    roidb = load_gt_roidbs(cfg)
+    if args.packed_dir:
+        from mx_rcnn_tpu.data.datasets import dataset_from_config
+        from mx_rcnn_tpu.data.datasets.imdb import filter_roidb
+        from mx_rcnn_tpu.data.packed import load_packed_roidb
+
+        roidb = load_packed_roidb(args.packed_dir, cfg)
+        if cfg.train.flip:
+            roidb = dataset_from_config(
+                cfg.dataset).append_flipped_images(roidb)
+        roidb = filter_roidb(roidb)
+    else:
+        roidb = load_gt_roidbs(cfg)
     fit_detector(
         cfg, roidb, args.prefix,
         begin_epoch=args.begin_epoch,
