@@ -159,6 +159,14 @@ class TrainConfig:
     # the big batch. The reference has no equivalent (SURVEY.md §3.2).
     # 1 = off.
     grad_accum_steps: int = 1
+    # Optimizer slot dtype: "float32" (default) or "bfloat16" — stores
+    # the SGD momentum / AdamW first-moment accumulator in bf16 (halves
+    # that tree's memory; the AdamW second moment always stays f32 — its
+    # precision matters for the rsqrt). NOTE measured NEUTRAL on step
+    # time on v5e (7.14 vs 7.21 ms DETR update — the update cost is a
+    # formulation-invariant floor, PERF.md r4); this knob is a MEMORY
+    # lever for big models, not a speed lever here.
+    opt_state_dtype: str = "float32"
     # Multi-step dispatch: each host call drives this many FULL optimizer
     # steps through one jitted lax.scan over step-stacked batches
     # (train/step.py), amortizing the fixed per-dispatch host/relay

@@ -24,6 +24,22 @@ import numpy as np
 from mx_rcnn_tpu.logger import logger
 
 
+def append_flipped_roidb(roidb: List[Dict], name: str = "roidb"
+                         ) -> List[Dict]:
+    """Double any roidb with flipped copies (flag + bookkeeping only —
+    pixels/boxes mirror at load time). Standalone so roidbs that never
+    touch a dataset instance (packed shards on a host without the raw
+    files) can flip too."""
+    flipped = []
+    for entry in roidb:
+        e = dict(entry)
+        e["flipped"] = True
+        flipped.append(e)
+    logger.info("%s appended flipped images: %d -> %d", name,
+                len(roidb), len(roidb) + len(flipped))
+    return roidb + flipped
+
+
 class IMDB:
     def __init__(self, name: str, image_set: str, root_path: str,
                  dataset_path: str):
@@ -75,14 +91,7 @@ class IMDB:
         """Double the roidb with flipped copies. The pixel flip happens at
         load time (data/loader.py); here only the flag + box bookkeeping
         (reference: imdb.py append_flipped_images)."""
-        flipped = []
-        for entry in roidb:
-            e = dict(entry)
-            e["flipped"] = True
-            flipped.append(e)
-        logger.info("%s appended flipped images: %d -> %d", self.name,
-                    len(roidb), len(roidb) + len(flipped))
-        return roidb + flipped
+        return append_flipped_roidb(roidb, name=self.name)
 
     # -- proposal roidb (alternate training / Fast R-CNN path) -----------
 

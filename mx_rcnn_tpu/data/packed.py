@@ -8,13 +8,14 @@ ancestor); this is the TPU-era replacement: decode and resize ONCE at pack
 time, then train-time loading is an mmap slice + normalize + pad.
 
 Format (one directory):
-  s{j}_shard_{k:04d}.npy  (N, Hb, Wb, 3) uint8 RGB, mmap-able; every image
+  s{j}_shard_{k:04d}_{l|p}.npy
+                      (N, Hb, Wb, 3) uint8 RGB, mmap-able; every image
                       is resized to training scale j and zero-padded to
-                      its ORIENTED pad bucket (landscape/portrait shards
-                      are packed separately so rows are uniform). One
-                      shard set per cfg.image.scales entry — multi-scale
-                      training draws a scale per batch and reads the
-                      matching set.
+                      its ORIENTED pad bucket (landscape `_l` and
+                      portrait `_p` shards are packed separately so rows
+                      are uniform). One shard set per cfg.image.scales
+                      entry — multi-scale training draws a scale per
+                      batch and reads the matching set.
   manifest.pkl        ONE dict per image: a `packed` map
                       {scale_idx: {file, index, hw, scale}} plus the
                       original roidb gt fields (boxes in ORIGINAL
@@ -106,6 +107,9 @@ def write_packed_dataset(roidb: List[Dict], cfg: Config, out_dir: str,
             arrs = {s: np.zeros(
                 (len(chunk), *_oriented_bucket(cfg, s, landscape), 3),
                 np.uint8) for s in scale_ids}
+            fnames = {s: (f"s{s}_shard_{shard_id:04d}_"
+                          f"{'l' if landscape else 'p'}.npy")
+                      for s in scale_ids}  # ONE name per (scale, shard)
             for row, i in enumerate(chunk):
                 entry = roidb[i]
                 img = (entry["image_data"].astype(np.float32)
@@ -124,15 +128,12 @@ def write_packed_dataset(roidb: List[Dict], cfg: Config, out_dir: str,
                     arrs[s][row, :rh, :rw] = np.clip(
                         np.rint(rimg), 0, 255).astype(np.uint8)
                     recs[i]["packed"][s] = {
-                        "file": f"s{s}_shard_{shard_id:04d}_"
-                                f"{'l' if landscape else 'p'}.npy",
+                        "file": fnames[s],
                         "index": row, "hw": (rh, rw),
                         "scale": float(scale),
                     }
             for s in scale_ids:
-                np.save(os.path.join(
-                    out_dir, f"s{s}_shard_{shard_id:04d}_"
-                             f"{'l' if landscape else 'p'}.npy"), arrs[s])
+                np.save(os.path.join(out_dir, fnames[s]), arrs[s])
                 n_shards += 1
             shard_id += 1
     manifest = {
@@ -179,6 +180,14 @@ def load_packed_roidb(out_dir: str, cfg: Optional[Config] = None
                 f"packed dataset geometry {have} does not match the "
                 f"training config {want}; re-pack with the same "
                 "network/image settings (tools/pack_dataset.py)")
+        missing = (set(range(len(cfg.image.scales)))
+                   - set(meta["scale_ids"]))
+        if missing:
+            raise ValueError(
+                f"packed dataset covers scale_ids {meta['scale_ids']} "
+                f"but the training config draws from "
+                f"{len(cfg.image.scales)} scales (missing {sorted(missing)})"
+                "; re-pack without scale_idx restriction")
     records = manifest["records"]
     for rec in records:
         for s in rec["packed"].values():

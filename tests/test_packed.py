@@ -157,6 +157,20 @@ def test_packed_geometry_validation(tmp_path):
         load_packed_roidb(str(tmp_path / "pack"), other)
 
 
+def test_packed_partial_scale_coverage_rejected(tmp_path):
+    """A pack restricted to a subset of the config's scales must fail at
+    LOAD time, not mid-epoch when the missing scale is drawn."""
+    cfg = _cfg(**{
+        "image.scales": ((96, 160), (128, 214)),
+        "image.pad_shapes": ((104, 168), (136, 216)),
+        "image.pad_shape": (216, 216),
+    })
+    roidb = _jpeg_roidb(tmp_path, n=2)
+    write_packed_dataset(roidb, cfg, str(tmp_path / "pack"), scale_idx=0)
+    with pytest.raises(ValueError, match="missing"):
+        load_packed_roidb(str(tmp_path / "pack"), cfg)
+
+
 def test_packed_old_format_rejected(tmp_path):
     import pickle
 

@@ -109,14 +109,15 @@ def main():
             num_classes=cfg.dataset.num_classes)
 
     if args.packed_dir:
-        from mx_rcnn_tpu.data.datasets import dataset_from_config
-        from mx_rcnn_tpu.data.datasets.imdb import filter_roidb
+        # No dataset construction here: a training host may hold ONLY the
+        # packed shards (the point of packing) — flip is roidb bookkeeping.
+        from mx_rcnn_tpu.data.datasets.imdb import (
+            append_flipped_roidb, filter_roidb)
         from mx_rcnn_tpu.data.packed import load_packed_roidb
 
         roidb = load_packed_roidb(args.packed_dir, cfg)
         if cfg.train.flip:
-            roidb = dataset_from_config(
-                cfg.dataset).append_flipped_images(roidb)
+            roidb = append_flipped_roidb(roidb, name=args.packed_dir)
         roidb = filter_roidb(roidb)
     else:
         roidb = load_gt_roidbs(cfg)
