@@ -473,6 +473,40 @@ def test_multi_step_dispatch_under_dp_mesh():
     assert np.isfinite(float(metrics["TotalLoss"]))
 
 
+@pytest.mark.slow
+def test_multi_step_dispatch_composes_with_grad_accum():
+    """multi=2 x accum=2: each scanned step consumes an accum-reshaped
+    batch and performs ONE update from 2 micro-grads — 2 updates per
+    dispatch over 4 images, equal to running the accum step twice.
+    (slow: scan body holds the unrolled double fwd+bwd — heavy compile.)"""
+    cfgA = _accum_cfg()  # accum=2, multi=1
+    cfgAM = _accum_cfg(multi_step_dispatch=2)  # accum=2, multi=2
+    model = build_model(cfgA)
+    params = init_params(model, cfgA, jax.random.PRNGKey(0))
+    tx = build_optimizer(cfgA, params, steps_per_epoch=10)
+    rng = jax.random.PRNGKey(9)
+    b0, b1 = _accum_batch(2), _accum_batch(2)
+    b1 = {**b1, "image": b1["image"] + 0.25}
+
+    multi_step = make_train_step(model, cfgAM, donate=False)
+    stacked = {k: jnp.stack([b0[k], b1[k]]) for k in b0}
+    state_m, metrics_m = multi_step(
+        create_train_state(params, tx), stacked, rng)
+
+    single = make_train_step(model, cfgA, donate=False)
+    keys = jax.random.split(rng, 2)
+    state_s = create_train_state(params, tx)
+    state_s, _ = single(state_s, b0, keys[0])
+    state_s, _ = single(state_s, b1, keys[1])
+
+    assert int(state_m.step) == 2
+    for a, b in zip(jax.tree.leaves(state_m.params),
+                    jax.tree.leaves(state_s.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert np.isfinite(float(metrics_m["TotalLoss"]))
+
+
 def test_multi_step_dispatch_fit_smoke(tmp_path):
     """fit_detector groups the loader stream into K-step dispatches and
     drops the trailing partial group."""
