@@ -203,6 +203,9 @@ def main():
         "value": headline,
         "unit": "img/s/chip",
         "vs_baseline": round(headline / REFERENCE_IMG_S, 3),
+        "baseline_provenance": ("reconstructed (5.0 img/s assumed; the "
+                                "reference publishes no throughput — "
+                                "BASELINE.md). MFU is the measured number."),
         "headline_config": headline_config,
         "detail": detail,
     }))

@@ -129,7 +129,8 @@ class TrainConfig:
     bg_thresh_hi: float = 0.5
     bg_thresh_lo: float = 0.0
     # NOTE: the reference uses bg_thresh_lo=0.1 for the Fast-RCNN path and 0.0
-    # for end2end; end2end default kept here.
+    # for end2end; end2end default kept here. tools/stages.py::train_rcnn
+    # applies the 0.1 Fast-RCNN preset for the alternate pipeline.
     # bbox regression target normalization (reference: config.TRAIN.BBOX_*).
     bbox_normalization_precomputed: bool = True
     bbox_means: tuple = (0.0, 0.0, 0.0, 0.0)

@@ -106,7 +106,16 @@ def train_rcnn(cfg: Config, prefix: str, rpn_file: str,
     """Fast-R-CNN fit over precomputed proposals (reference:
     tools/train_rcnn.py over ROIIter, incl. its add_bbox_regression_targets
     call when bbox normalization is not precomputed)."""
+    from dataclasses import replace
+
     from mx_rcnn_tpu.targets.bbox_stats import resolve_bbox_stats
+
+    # Fast-RCNN parity: the reference samples bg rois from IoU in [0.1, 0.5)
+    # on this path (vs [0.0, 0.5) end2end). Apply the preset here so the
+    # alternate pipeline matches without a CLI flag; an explicit non-default
+    # bg_thresh_lo override is respected.
+    if cfg.train.bg_thresh_lo == 0.0:
+        cfg = cfg.with_updates(train=replace(cfg.train, bg_thresh_lo=0.1))
 
     roidb = _attach_proposals(cfg, rpn_file)
     cfg = resolve_bbox_stats(cfg, roidb)

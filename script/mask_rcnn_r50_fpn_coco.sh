@@ -6,6 +6,7 @@ cd "$(dirname "$0")/.."
 python train_end2end.py \
   --network resnet50_fpn_mask --dataset coco --image_set train2017 \
   --prefix model/mask_r50_fpn_coco --end_epoch 8 --lr 0.00125 --lr_step 6 \
+  --set network.proposal_topk=exact \
   --tpu-mesh "${TPU_MESH:-8}" "$@"
 
 python test.py --batch_size 4 \

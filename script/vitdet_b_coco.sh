@@ -9,6 +9,7 @@ cd "$(dirname "$0")/.."
 python train_end2end.py \
   --network vitdet_b --dataset coco --image_set train2017 \
   --prefix model/vitdet_b_coco --end_epoch 8 --lr 0.0001 --lr_step 6 \
+  --set network.proposal_topk=exact \
   --tpu-mesh "${TPU_MESH:-8}" "$@"
 
 python test.py --batch_size 4 \
