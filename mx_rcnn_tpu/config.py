@@ -55,6 +55,10 @@ class NetworkConfig:
     use_fpn: bool = False
     fpn_strides: tuple = (4, 8, 16, 32, 64)
     fpn_channels: int = 256
+    # Fused shared-RPN-head application: pack P2..P6 into one zero-gapped
+    # canvas and run the head ONCE instead of five small-grid convs
+    # (models/fpn.py::rpn_forward_packed; semantics identical — tested).
+    fpn_packed_rpn_head: bool = True
     # Mask head (Mask R-CNN configs).
     use_mask: bool = False
     mask_pool_size: int = 14

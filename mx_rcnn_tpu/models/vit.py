@@ -393,6 +393,13 @@ class ViTDet(nn.Module):
 
         return {lv: self.rpn(pyramid[lv]) for lv in RPN_LEVELS}
 
+    def rpn_forward_packed(self, pyramid: Dict[int, jnp.ndarray]):
+        """One fused head application over all levels (see
+        models/fpn.py::FPNFasterRCNN.rpn_forward_packed)."""
+        from mx_rcnn_tpu.models.fpn import apply_rpn_head_packed
+
+        return apply_rpn_head_packed(self.rpn, pyramid)
+
     def box_head(self, pooled: jnp.ndarray):
         x = self.head(pooled)
         return (self.cls_score(x).astype(jnp.float32),

@@ -1,0 +1,146 @@
+"""Generate an on-disk COCO-format dataset with synthetic content.
+
+The offline launch-readiness rehearsal (SURVEY.md §8: no real COCO in this
+container) needs everything a real run touches — thousands of JPEG files,
+a real ``instances_*.json`` parse, the pack_dataset CLI, multi-epoch
+training, test.py → COCOEval — with only the pixels being synthetic.
+This tool writes the exact layout ``script/get_coco.sh`` documents:
+
+    <root>/annotations/instances_<set>.json
+    <root>/<set>/*.jpg
+
+Content mirrors data/datasets/synthetic.py: colored axis-aligned
+rectangles on textured noise, class = color, so a detector trained on the
+generated train set must generalize to the held-out val set (the color→
+class mapping is learnable; mAP has a meaningful floor). The category
+list is the full 80-entry COCO one so ``generate_config(..., "coco")``'s
+num_classes=81 matches; only the first ``--colors`` categories ever
+appear in annotations.
+
+Usage:
+    python -m mx_rcnn_tpu.tools.gen_synthetic_coco \
+        --root data/coco --train 2400 --val 240 [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List
+
+import numpy as np
+
+# 16 visually distinct colors; class id = index + 1 (COCO category ids
+# are arbitrary ints — we use 1..80 for simplicity, which COCOEval and
+# the in-repo coco.py handle identically to the real sparse ids).
+_COLORS = np.asarray([
+    (220, 40, 40), (40, 200, 60), (50, 80, 230), (230, 200, 40),
+    (230, 40, 200), (40, 220, 220), (140, 70, 20), (120, 120, 120),
+    (250, 150, 50), (90, 40, 130), (170, 220, 120), (60, 120, 90),
+    (240, 120, 160), (30, 40, 90), (200, 170, 130), (100, 200, 250),
+], np.float32)
+
+_COCO_CATEGORIES = [
+    "person", "bicycle", "car", "motorcycle", "airplane", "bus", "train",
+    "truck", "boat", "traffic light", "fire hydrant", "stop sign",
+    "parking meter", "bench", "bird", "cat", "dog", "horse", "sheep",
+    "cow", "elephant", "bear", "zebra", "giraffe", "backpack", "umbrella",
+    "handbag", "tie", "suitcase", "frisbee", "skis", "snowboard",
+    "sports ball", "kite", "baseball bat", "baseball glove", "skateboard",
+    "surfboard", "tennis racket", "bottle", "wine glass", "cup", "fork",
+    "knife", "spoon", "bowl", "banana", "apple", "sandwich", "orange",
+    "broccoli", "carrot", "hot dog", "pizza", "donut", "cake", "chair",
+    "couch", "potted plant", "bed", "dining table", "toilet", "tv",
+    "laptop", "mouse", "remote", "keyboard", "cell phone", "microwave",
+    "oven", "toaster", "sink", "refrigerator", "book", "clock", "vase",
+    "scissors", "teddy bear", "hair drier", "toothbrush",
+]
+
+
+def _gen_image(rs: np.random.RandomState, n_colors: int):
+    """One synthetic image + its annotations (bbox xyxy, class ids)."""
+    h = int(rs.randint(360, 640))
+    w = int(rs.randint(480, 800))
+    if rs.rand() < 0.35:  # mixed orientation, like real COCO
+        h, w = w, h
+    img = rs.uniform(70, 160, (h, w, 3)).astype(np.float32)
+    n = int(rs.randint(1, 6))
+    boxes, classes = [], []
+    for _ in range(n):
+        bw = int(rs.randint(min(h, w) // 8, min(h, w) // 2))
+        bh = int(rs.randint(min(h, w) // 8, min(h, w) // 2))
+        x1 = int(rs.randint(0, w - bw))
+        y1 = int(rs.randint(0, h - bh))
+        cls = int(rs.randint(1, n_colors + 1))
+        color = _COLORS[cls - 1] + rs.uniform(-12, 12, 3)
+        img[y1:y1 + bh, x1:x1 + bw] = color
+        boxes.append((x1, y1, bw, bh))  # COCO xywh
+        classes.append(cls)
+    return np.clip(img, 0, 255).astype(np.uint8), boxes, classes
+
+
+def generate_split(root: str, image_set: str, num_images: int,
+                   seed: int, n_colors: int = 8,
+                   quality: int = 90) -> Dict:
+    import cv2
+
+    img_dir = os.path.join(root, image_set)
+    os.makedirs(img_dir, exist_ok=True)
+    os.makedirs(os.path.join(root, "annotations"), exist_ok=True)
+    rs = np.random.RandomState(seed)
+    images: List[Dict] = []
+    annotations: List[Dict] = []
+    ann_id = 1
+    for i in range(num_images):
+        img, boxes, classes = _gen_image(rs, n_colors)
+        name = f"{i:012d}.jpg"
+        cv2.imwrite(os.path.join(img_dir, name), img[:, :, ::-1],
+                    [cv2.IMWRITE_JPEG_QUALITY, quality])
+        images.append({
+            "id": i + 1, "file_name": name,
+            "height": int(img.shape[0]), "width": int(img.shape[1]),
+        })
+        for (x, y, bw, bh), cls in zip(boxes, classes):
+            annotations.append({
+                "id": ann_id, "image_id": i + 1, "category_id": cls,
+                "bbox": [float(x), float(y), float(bw), float(bh)],
+                "area": float(bw * bh), "iscrowd": 0,
+                # box-outline polygon: exercises the segmentation parse
+                "segmentation": [[float(x), float(y), float(x + bw),
+                                  float(y), float(x + bw), float(y + bh),
+                                  float(x), float(y + bh)]],
+            })
+            ann_id += 1
+    data = {
+        "info": {"description": "synthetic COCO-format rehearsal set"},
+        "images": images,
+        "annotations": annotations,
+        "categories": [{"id": c + 1, "name": n, "supercategory": "none"}
+                       for c, n in enumerate(_COCO_CATEGORIES)],
+    }
+    out = os.path.join(root, "annotations", f"instances_{image_set}.json")
+    with open(out, "w") as f:
+        json.dump(data, f)
+    return {"images": len(images), "annotations": len(annotations),
+            "json": out}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--root", default="data/coco")
+    p.add_argument("--train", type=int, default=2400)
+    p.add_argument("--val", type=int, default=240)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--colors", type=int, default=8,
+                   help="distinct object classes actually drawn (<=16)")
+    args = p.parse_args(argv)
+    for image_set, n, seed in (("train2017", args.train, args.seed),
+                               ("val2017", args.val, args.seed + 7919)):
+        info = generate_split(args.root, image_set, n, seed,
+                              n_colors=min(args.colors, len(_COLORS)))
+        print(f"{image_set}: {info}")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.compile_heavy
+
 from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.models import detr as D
 from mx_rcnn_tpu.models import zoo

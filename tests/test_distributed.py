@@ -16,6 +16,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.compile_heavy
+
 WORKER = r"""
 import os, sys
 sys.path.insert(0, os.environ["REPO"])

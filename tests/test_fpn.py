@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.compile_heavy
+
 from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.models import fpn as F
 from mx_rcnn_tpu.models import zoo
@@ -355,3 +357,79 @@ def test_fpn_dp_parity(rng):
     for a, b in zip(l1, l2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
                                    atol=2e-5)
+
+
+def test_pack_placements_gaps_and_bounds():
+    """Shelf packing: every rectangle in bounds, pairwise >=1px separated."""
+    shapes = [(40, 64), (20, 32), (10, 16), (5, 8), (3, 4)]
+    (hc, wc), places = F.pack_placements(shapes)
+    assert wc == 64
+    rects = []
+    for (h, w), (y, x, ph, pw) in zip(shapes, places):
+        assert (ph, pw) == (h, w)
+        assert 0 <= y and y + h <= hc and 0 <= x and x + w <= wc
+        rects.append((y, x, h, w))
+    for i in range(len(rects)):
+        for j in range(i + 1, len(rects)):
+            yi, xi, hi, wi = rects[i]
+            yj, xj, hj, wj = rects[j]
+            # grow rect i by the 1px gap; it must not intersect rect j
+            sep = (yi + hi + 1 <= yj or yj + hj + 1 <= yi
+                   or xi + wi + 1 <= xj or xj + wj + 1 <= xi)
+            assert sep, (rects[i], rects[j])
+
+
+def test_pack_levels_roundtrip(rng):
+    """Canvas slices reproduce the packed tensors; gaps are zero."""
+    shapes = [(16, 32), (8, 16), (4, 8)]
+    tensors = [jnp.asarray(rng.randn(2, h, w, 3), jnp.float32)
+               for h, w in shapes]
+    canvas, places = F.pack_levels(tensors)
+    total = 0.0
+    for t, (y, x, h, w) in zip(tensors, places):
+        np.testing.assert_array_equal(
+            np.asarray(canvas[:, y:y + h, x:x + w, :]), np.asarray(t))
+        total += float(jnp.sum(jnp.abs(t)))
+    assert np.isclose(float(jnp.sum(jnp.abs(canvas))), total, rtol=1e-6)
+
+
+def test_rpn_forward_packed_matches_per_level(rng):
+    """The fused one-canvas head application == five per-level applications
+    (same params; 3x3 SAME borders see zeros either way)."""
+    cfg = tiny_cfg()
+    model = zoo.build_model(cfg)
+    params = zoo.init_params(model, cfg, jax.random.PRNGKey(0))
+    images = jnp.asarray(rng.randn(1, 128, 128, 3), jnp.float32)
+    pyramid = jax.jit(
+        lambda p, im: model.apply(p, im, method="extract"))(params, images)
+    per_level = jax.jit(lambda p, pyr: model.apply(
+        p, pyr, method="rpn_forward"))(params, pyramid)
+    packed = jax.jit(lambda p, pyr: model.apply(
+        p, pyr, method="rpn_forward_packed"))(params, pyramid)
+    for lv in F.RPN_LEVELS:
+        for a, b in zip(per_level[lv], packed[lv]):
+            assert a.shape == b.shape, lv
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-2, atol=2e-3)
+
+
+def test_forward_train_packed_vs_unpacked_rpn(rng):
+    """End-to-end train loss with the packed head == per-level head."""
+    from dataclasses import replace
+
+    cfg = tiny_cfg()
+    assert cfg.network.fpn_packed_rpn_head  # default on
+    cfg_off = cfg.with_updates(network=replace(
+        cfg.network, fpn_packed_rpn_head=False))
+    model = zoo.build_model(cfg)
+    params = zoo.init_params(model, cfg, jax.random.PRNGKey(0))
+    batch = tiny_batch(rng)
+    key = jax.random.PRNGKey(1)
+    loss_on, _ = jax.jit(
+        lambda p, b, r: zoo.forward_train(model, p, b, r, cfg)
+    )(params, batch, key)
+    loss_off, _ = jax.jit(
+        lambda p, b, r: zoo.forward_train(model, p, b, r, cfg_off)
+    )(params, batch, key)
+    np.testing.assert_allclose(float(loss_on), float(loss_off),
+                               rtol=1e-4, atol=1e-5)

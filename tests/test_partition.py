@@ -14,6 +14,8 @@ from functools import partial
 import jax
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.compile_heavy
 from jax.sharding import PartitionSpec as P
 
 from mx_rcnn_tpu.config import generate_config

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.compile_heavy
+
 from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.models import zoo
 from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch

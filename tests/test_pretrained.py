@@ -10,6 +10,8 @@ tree, so a wrong transpose, name map, or routing rule fails here.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.compile_heavy
+
 import jax
 
 from mx_rcnn_tpu.config import generate_config

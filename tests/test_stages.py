@@ -13,6 +13,8 @@ import pickle
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.compile_heavy
+
 import jax
 import jax.numpy as jnp
 
