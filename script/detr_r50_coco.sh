@@ -8,9 +8,9 @@ cd "$(dirname "$0")/.."
 python train_end2end.py \
   --network detr_r50 --dataset coco --image_set train2017 \
   --prefix model/detr_r50_coco --end_epoch 300 --lr 0.0001 --lr_step 200 \
-  --tpu-mesh "${TPU_MESH:-8}" "$@"
+  --tpu-mesh "${TPU_MESH:-8}" ${COMMON_SET:-} "$@"
 
 python test.py --batch_size 4 \
   --network detr_r50 --dataset coco --image_set val2017 \
   --prefix model/detr_r50_coco --epoch 300 \
-  --out_json results/detr_r50_coco_dets.json
+  --out_json results/detr_r50_coco_dets.json ${COMMON_SET:-}

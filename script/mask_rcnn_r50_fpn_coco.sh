@@ -7,9 +7,9 @@ python train_end2end.py \
   --network resnet50_fpn_mask --dataset coco --image_set train2017 \
   --prefix model/mask_r50_fpn_coco --end_epoch 8 --lr 0.00125 --lr_step 6 \
   --set network.proposal_topk=exact \
-  --tpu-mesh "${TPU_MESH:-8}" "$@"
+  --tpu-mesh "${TPU_MESH:-8}" ${COMMON_SET:-} "$@"
 
 python test.py --batch_size 4 \
   --network resnet50_fpn_mask --dataset coco --image_set val2017 \
   --prefix model/mask_r50_fpn_coco --epoch 8 \
-  --out_json results/mask_r50_fpn_coco_dets.json
+  --out_json results/mask_r50_fpn_coco_dets.json ${COMMON_SET:-}

@@ -8,9 +8,9 @@ cd "$(dirname "$0")/.."
 python train_end2end.py \
   --network resnet101 --dataset coco --image_set train2017 \
   --prefix model/r101_coco_e2e --end_epoch 8 --lr 0.00125 --lr_step 6 \
-  --tpu-mesh "${TPU_MESH:-8}" "$@"
+  --tpu-mesh "${TPU_MESH:-8}" ${COMMON_SET:-} "$@"
 
 python test.py --batch_size 4 \
   --network resnet101 --dataset coco --image_set val2017 \
   --prefix model/r101_coco_e2e --epoch 8 \
-  --out_json results/r101_coco_dets.json
+  --out_json results/r101_coco_dets.json ${COMMON_SET:-}

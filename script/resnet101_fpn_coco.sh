@@ -1,6 +1,12 @@
 #!/usr/bin/env bash
 # ResNet-101-FPN Faster R-CNN e2e on COCO — BASELINE.json config 3
 # (multi-scale FPN, 8-way DP).
+#
+# COMMON_SET: --set overrides that must reach BOTH the train and eval
+# CLIs (anything that changes the model architecture — norm, freeze_at,
+# channels — must match at eval or the checkpoint restore fails; found
+# by the r5 on-disk rehearsal). Train-only flags go through "$@".
+#   COMMON_SET="--set network.norm=group" script/resnet101_fpn_coco.sh ...
 set -euxo pipefail
 cd "$(dirname "$0")/.."
 
@@ -8,9 +14,9 @@ python train_end2end.py \
   --network resnet101_fpn --dataset coco --image_set train2017 \
   --prefix model/r101_fpn_coco --end_epoch 8 --lr 0.00125 --lr_step 6 \
   --set network.proposal_topk=exact \
-  --tpu-mesh "${TPU_MESH:-8}" "$@"
+  --tpu-mesh "${TPU_MESH:-8}" ${COMMON_SET:-} "$@"
 
 python test.py --batch_size 4 \
   --network resnet101_fpn --dataset coco --image_set val2017 \
   --prefix model/r101_fpn_coco --epoch 8 \
-  --out_json results/r101_fpn_coco_dets.json
+  --out_json results/r101_fpn_coco_dets.json ${COMMON_SET:-}

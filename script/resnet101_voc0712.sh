@@ -8,8 +8,8 @@ python train_end2end.py \
   --network resnet101 --dataset PascalVOC \
   --image_set 2007_trainval+2012_trainval \
   --prefix model/r101_voc0712_e2e --end_epoch 10 --lr 0.001 --lr_step 7 \
-  --tpu-mesh "${TPU_MESH:-1}" "$@"
+  --tpu-mesh "${TPU_MESH:-1}" ${COMMON_SET:-} "$@"
 
 python test.py --batch_size 4 \
   --network resnet101 --dataset PascalVOC --image_set 2007_test \
-  --prefix model/r101_voc0712_e2e --epoch 10
+  --prefix model/r101_voc0712_e2e --epoch 10 ${COMMON_SET:-}

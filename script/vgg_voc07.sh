@@ -8,8 +8,8 @@ cd "$(dirname "$0")/.."
 python train_end2end.py \
   --network vgg --dataset PascalVOC --image_set 2007_trainval \
   --prefix model/vgg_voc07_e2e --end_epoch 10 --lr 0.001 --lr_step 7 \
-  --tpu-mesh "${TPU_MESH:-1}" "$@"
+  --tpu-mesh "${TPU_MESH:-1}" ${COMMON_SET:-} "$@"
 
 python test.py --batch_size 4 \
   --network vgg --dataset PascalVOC --image_set 2007_test \
-  --prefix model/vgg_voc07_e2e --epoch 10
+  --prefix model/vgg_voc07_e2e --epoch 10 ${COMMON_SET:-}

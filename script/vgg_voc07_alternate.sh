@@ -7,8 +7,8 @@ cd "$(dirname "$0")/.."
 python train_alternate.py \
   --network vgg --dataset PascalVOC --image_set 2007_trainval \
   --prefix model/vgg_voc07_alt --rpn_epoch 8 --rcnn_epoch 8 \
-  --tpu-mesh "${TPU_MESH:-1}" "$@"
+  --tpu-mesh "${TPU_MESH:-1}" ${COMMON_SET:-} "$@"
 
 python test.py --batch_size 4 \
   --network vgg --dataset PascalVOC --image_set 2007_test \
-  --prefix model/vgg_voc07_alt --epoch 8
+  --prefix model/vgg_voc07_alt --epoch 8 ${COMMON_SET:-}

@@ -10,9 +10,9 @@ python train_end2end.py \
   --network vitdet_b --dataset coco --image_set train2017 \
   --prefix model/vitdet_b_coco --end_epoch 8 --lr 0.0001 --lr_step 6 \
   --set network.proposal_topk=exact \
-  --tpu-mesh "${TPU_MESH:-8}" "$@"
+  --tpu-mesh "${TPU_MESH:-8}" ${COMMON_SET:-} "$@"
 
 python test.py --batch_size 4 \
   --network vitdet_b --dataset coco --image_set val2017 \
   --prefix model/vitdet_b_coco --epoch 8 \
-  --out_json results/vitdet_b_coco_dets.json
+  --out_json results/vitdet_b_coco_dets.json ${COMMON_SET:-}

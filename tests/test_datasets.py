@@ -260,3 +260,33 @@ def test_coco_segm_eval_wrong_masks_score_low(coco):
     stats = coco.evaluate_segmentations(all_boxes, all_masks)
     assert stats["segm_AP"] < 0.2, stats
     assert stats["AP"] > 0.7
+
+
+def test_gen_synthetic_coco_roundtrip(tmp_path):
+    """tools/gen_synthetic_coco writes the documented COCO layout and the
+    real COCODataset parses it (the r5 launch-rehearsal data path)."""
+    pytest.importorskip("cv2")
+    from mx_rcnn_tpu.tools.gen_synthetic_coco import generate_split
+
+    root = str(tmp_path / "coco")
+    info = generate_split(root, "val2017", num_images=4, seed=11)
+    assert info["images"] == 4 and info["annotations"] >= 4
+    ds = COCODataset("val2017", root_path=str(tmp_path), dataset_path=root)
+    roidb = ds.gt_roidb()
+    assert len(roidb) == 4
+    assert ds.num_classes == 81  # full COCO category list declared
+    for e in roidb:
+        assert os.path.exists(e["image"])
+        assert e["boxes"].shape[0] == e["gt_classes"].shape[0] >= 1
+        assert (e["gt_classes"] >= 1).all() and (e["gt_classes"] <= 16).all()
+    # Validate the RAW json (COCODataset clips boxes at parse time, so
+    # roidb bounds checks would be tautological): every xywh bbox must
+    # already lie within its image.
+    import json as _json
+
+    raw = _json.load(open(info["json"]))
+    dims = {im["id"]: (im["width"], im["height"]) for im in raw["images"]}
+    for ann in raw["annotations"]:
+        w, h = dims[ann["image_id"]]
+        x, y, bw, bh = ann["bbox"]
+        assert 0 <= x and 0 <= y and x + bw <= w and y + bh <= h, ann
