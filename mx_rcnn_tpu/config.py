@@ -77,6 +77,16 @@ class NetworkConfig:
     # the mesh model-axis size).
     use_ring_attention: bool = False
     sp_mode: str = "ring"
+    # Single-device attention formulation for the ViTDet GLOBAL blocks
+    # (the only dense-attention site with enough tokens to matter —
+    # DETR's 640-token encoder is below any practical chunk, and its MHA
+    # stays dense; windowed blocks are 64-token tiles): "dense" (one
+    # (S,S) score buffer — XLA fuses well at detector sequence lengths)
+    # or "streaming" (flash-style key-block scan, O(S·chunk) memory;
+    # ops/ring_attention.py). Exact either way; a speed/memory knob
+    # measured in PERF.md r5. Ignored (with a warning) under pp_stages.
+    attn_impl: str = "dense"
+    attn_kv_chunk: int = 1024
     # Tensor parallelism over the mesh `model` axis (parallel/partition.py):
     # Megatron-split transformer MLP/attention weights and the paired
     # fc6/fc7 detection heads; GSPMD inserts the collectives. Composes

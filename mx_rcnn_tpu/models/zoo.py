@@ -108,6 +108,27 @@ def build_model(cfg: Config, mesh=None):
                 "ignored: build_model() was called without a mesh; using "
                 "dense attention (same numerics, no SP)",
                 cfg.network.use_ring_attention, cfg.network.sp_mode)
+        if attn_fn is None and cfg.network.attn_impl == "streaming":
+            if cfg.network.pp_stages:
+                # The staged encoder manages its own attention internals;
+                # the knob cannot be routed through pipeline_fn.
+                from mx_rcnn_tpu.logger import logger
+
+                logger.warning(
+                    "network.attn_impl='streaming' ignored under "
+                    "pp_stages=%d (the staged ViT encoder uses its own "
+                    "dense attention; numerics unchanged)",
+                    cfg.network.pp_stages)
+            else:
+                # Flash-style streaming softmax for the single-device
+                # dense path: O(S·chunk) score memory instead of O(S²).
+                # Exact (the kernel SP uses locally); a speed/memory
+                # knob, not an approximation (PERF.md r5).
+                from mx_rcnn_tpu.ops.ring_attention import (
+                    streaming_attention)
+
+                attn_fn = partial(streaming_attention,
+                                  kv_chunk=cfg.network.attn_kv_chunk)
         pipeline_fn = None
         if cfg.network.pp_stages and mesh is not None:
             if "model" not in mesh.axis_names or (

@@ -225,3 +225,23 @@ def test_ulysses_attention_matches_dense(rng):
     l_dense, _ = jax.jit(lambda p, b, r: zoo.forward_train(
         model_dense, p, b, r, cfg_dense))(params, batch, key)
     assert np.isclose(float(l_sp), float(l_dense), rtol=1e-4)
+
+
+def test_streaming_attn_impl_matches_dense(rng):
+    """network.attn_impl='streaming' routes the global blocks through the
+    flash-style streaming-softmax kernel with identical numerics (r5; a
+    small kv_chunk forces a real multi-block scan at tiny token counts)."""
+    cfg = tiny_cfg(**{"network.attn_impl": "streaming",
+                      "network.attn_kv_chunk": 8})
+    model_s = zoo.build_model(cfg)
+    cfg_d = cfg.with_updates(
+        network=replace(cfg.network, attn_impl="dense"))
+    model_d = zoo.build_model(cfg_d)
+    params = zoo.init_params(model_d, cfg_d, jax.random.PRNGKey(0))
+    batch = tiny_batch(rng)
+    key = jax.random.PRNGKey(1)
+    l_s, _ = jax.jit(lambda p, b, r: zoo.forward_train(
+        model_s, p, b, r, cfg))(params, batch, key)
+    l_d, _ = jax.jit(lambda p, b, r: zoo.forward_train(
+        model_d, p, b, r, cfg_d))(params, batch, key)
+    assert np.isclose(float(l_s), float(l_d), rtol=1e-4), (l_s, l_d)
