@@ -68,7 +68,8 @@ def make_batch(cfg):
     valid = np.zeros((b, g), bool)
     valid[:, :n_boxes] = True
     classes = np.zeros((b, g), np.int32)
-    classes[:, :n_boxes] = rs.randint(1, 81, (b, n_boxes))
+    classes[:, :n_boxes] = rs.randint(1, cfg.dataset.num_classes,
+                                      (b, n_boxes))
     batch = {
         "image": rs.randn(b, h, w, 3).astype(np.float32),
         "im_info": np.asarray([[600, 1000, 1.0]] * b, np.float32),
@@ -224,6 +225,12 @@ def main():
         # BASELINE config 3 (acceptance config).
         "fpn_r101": cfg_for("resnet101_fpn", 1),
         "fpn_r101_b2": cfg_for("resnet101_fpn", 2),
+        # The acceptance recipe (script/resnet101_fpn_coco.sh) pins
+        # exact top-k; the preset default is approx. Bench both so the
+        # recorded number matches what the recipe would run.
+        "fpn_r101_b2_exact": generate_config("resnet101_fpn", "coco", **{
+            "image.pad_shape": (640, 1024), "train.batch_images": 2,
+            "network.proposal_topk": "exact"}),
         "fpn_r101_msd8": cfg_for("resnet101_fpn", 1, multi=8),
         # BASELINE config 4 (+ b2: amortizes per-dispatch overhead and the
         # HBM-bound optimizer floor; PERF.md "batch>1 lever").
