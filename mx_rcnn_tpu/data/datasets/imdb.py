@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -69,10 +70,21 @@ class IMDB:
 
     def gt_roidb(self) -> List[Dict]:
         """Ground-truth roidb with a pickle cache (reference behavior,
-        plus schema versioning the reference lacks)."""
+        plus schema versioning the reference lacks, plus a dataset_path
+        discriminator the reference also lacks: two datasets sharing a
+        split name but living at different paths must not reuse each
+        other's cache — found by the r5 on-disk rehearsal, where a
+        small-copy dataset silently loaded the full set's 2400-entry
+        roidb)."""
+        path_tag = ""
+        if self.dataset_path:
+            digest = zlib.crc32(
+                os.path.realpath(self.dataset_path).encode())
+            path_tag = f"_{digest:08x}"
         cache_file = os.path.join(
             self.cache_path,
-            f"{self.name}_gt_roidb_v{self.ROIDB_SCHEMA_VERSION}.pkl")
+            f"{self.name}{path_tag}_gt_roidb_v"
+            f"{self.ROIDB_SCHEMA_VERSION}.pkl")
         if os.path.exists(cache_file):
             with open(cache_file, "rb") as f:
                 roidb = pickle.load(f)

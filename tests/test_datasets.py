@@ -290,3 +290,21 @@ def test_gen_synthetic_coco_roundtrip(tmp_path):
         w, h = dims[ann["image_id"]]
         x, y, bw, bh = ann["bbox"]
         assert 0 <= x and 0 <= y and x + bw <= w and y + bh <= h, ann
+
+
+def test_gt_roidb_cache_distinguishes_dataset_paths(tmp_path):
+    """Two COCO datasets sharing a split name at DIFFERENT paths must not
+    reuse each other's roidb cache (r5 rehearsal bug: a small-copy set
+    silently loaded the full set's pickle)."""
+    pytest.importorskip("cv2")
+    from mx_rcnn_tpu.tools.gen_synthetic_coco import generate_split
+
+    a = str(tmp_path / "a"); b = str(tmp_path / "b")
+    generate_split(a, "val2017", num_images=3, seed=1)
+    generate_split(b, "val2017", num_images=5, seed=2)
+    root = str(tmp_path)  # shared root_path -> shared cache dir
+    ds_a = COCODataset("val2017", root_path=root, dataset_path=a)
+    ds_b = COCODataset("val2017", root_path=root, dataset_path=b)
+    assert len(ds_a.gt_roidb()) == 3
+    assert len(ds_b.gt_roidb()) == 5  # not the cached 3-entry roidb
+    assert len(ds_a.gt_roidb()) == 3  # both caches coexist
