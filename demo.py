@@ -13,6 +13,7 @@ import argparse
 import jax
 import numpy as np
 
+from mx_rcnn_tpu.utils.compile_cache import enable_persistent_cache
 from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.data.image import (
     load_image, pad_image, resize_image, transform_image)
@@ -40,6 +41,7 @@ def parse_args():
 
 
 def main():
+    enable_persistent_cache()
     args = parse_args()
     overrides = {}
     if args.from_scratch:

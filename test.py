@@ -10,6 +10,7 @@ import argparse
 
 import jax
 
+from mx_rcnn_tpu.utils.compile_cache import enable_persistent_cache
 from mx_rcnn_tpu.config import generate_config, parse_cli_overrides
 from mx_rcnn_tpu.data.datasets import dataset_from_config
 from mx_rcnn_tpu.data.loader import TestLoader
@@ -44,6 +45,7 @@ def parse_args():
 
 
 def main():
+    enable_persistent_cache()
     args = parse_args()
     overrides = {}
     if args.root_path:

@@ -26,9 +26,12 @@ jax.config.update("jax_platforms", "cpu")
 # re-compiles of the same jitted steps across test processes/runs; cache
 # them on disk (tests/.jax_cache, gitignored) so repeat runs pay tracing
 # only. Threshold 0.1s keeps only trivial kernels out of the cache.
-_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from mx_rcnn_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
 
 import numpy as np
 import pytest

@@ -20,6 +20,7 @@ import os
 
 import jax
 
+from mx_rcnn_tpu.utils.compile_cache import enable_persistent_cache
 from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.tools.stages import (
@@ -81,6 +82,7 @@ def alternate_train(cfg, prefix, rpn_epoch, rcnn_epoch, mesh_spec="",
 
 
 def main():
+    enable_persistent_cache()
     args = parse_args()
     overrides = {}
     if args.image_set:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 
+from mx_rcnn_tpu.utils.compile_cache import enable_persistent_cache
 from mx_rcnn_tpu.config import generate_config, parse_cli_overrides
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.tools.train import fit_detector, load_gt_roidbs
@@ -67,6 +68,7 @@ def parse_args():
 
 
 def main():
+    enable_persistent_cache()
     # Multi-host (dist_sync analog): connect BEFORE any jax device use.
     from mx_rcnn_tpu.parallel.distributed import maybe_initialize_distributed
     maybe_initialize_distributed()
