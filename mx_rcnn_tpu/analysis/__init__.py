@@ -1,0 +1,31 @@
+"""graftlint — this repo's AST-based static-analysis pass.
+
+Machine-enforces the trace-safety and config conventions the codebase
+already follows by hand (see the package docstring's "Design rules" and
+train/step.py's donation convention), before multi-chip debugging makes
+violations expensive:
+
+- host-sync-in-jit      host round-trips inside traced code
+- data-dependent-shape  dynamic result shapes (TPU recompile bombs)
+- missing-donation      jitted state steps without buffer donation
+- prng-key-reuse        a key consumed twice without a split
+- cfg-contract          cfg.section.field chains resolved against config.py
+- broad-except          `except Exception` outside import probes
+
+Run ``python -m mx_rcnn_tpu.analysis`` (configured via
+``[tool.graftlint]`` in pyproject.toml); the API surface for tests is
+``lint_source`` / ``run``. Stdlib-only — importing this package never
+imports jax.
+"""
+
+from mx_rcnn_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    LintResult,
+    lint_file,
+    lint_source,
+    run,
+)
+from mx_rcnn_tpu.analysis.settings import Settings, find_repo_root  # noqa: F401
+
+__all__ = ["Finding", "LintResult", "lint_file", "lint_source", "run",
+           "Settings", "find_repo_root"]

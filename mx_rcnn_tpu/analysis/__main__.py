@@ -1,0 +1,7 @@
+"""``python -m mx_rcnn_tpu.analysis`` — see cli.py."""
+
+import sys
+
+from mx_rcnn_tpu.analysis.cli import main
+
+sys.exit(main())

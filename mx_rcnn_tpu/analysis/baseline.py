@@ -1,0 +1,81 @@
+"""Baseline suppression: adopt-now, ratchet-later.
+
+The baseline file records pre-existing findings as (path, rule, text)
+triples — ``text`` is the stripped source line, so entries survive line
+drift from unrelated edits but die the moment the flagged line itself is
+touched (at which point the author must fix it or re-baseline
+deliberately with ``--write-baseline``). Counts make duplicate identical
+lines in one file behave sanely: a baseline with count 2 absorbs at most
+two matching findings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, Iterable, List, Tuple
+
+from mx_rcnn_tpu.analysis.engine import Finding
+
+Key = Tuple[str, str, str]  # (path, rule, stripped line text)
+
+
+def _key(f: Finding) -> Key:
+    return (f.path, f.rule, f.text)
+
+
+def load(path: str) -> List[dict]:
+    if not os.path.isfile(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return list(data.get("suppressions", []))
+
+
+def write(path: str, findings: Iterable[Finding],
+          keep: Iterable[dict] = ()) -> int:
+    """Adopt ``findings``; ``keep`` carries forward entries for files
+    outside the linted scope so a subset --write-baseline cannot silently
+    drop another file's suppressions."""
+    counts: Counter = Counter(_key(f) for f in findings)
+    for e in keep:
+        counts[(e["path"], e["rule"], e.get("text", ""))] += int(
+            e.get("count", 1))
+    entries = [
+        {"path": p, "rule": r, "text": t, "count": n}
+        for (p, r, t), n in sorted(counts.items())
+    ]
+    payload = {
+        "comment": ("graftlint baseline — pre-existing findings adopted "
+                    "when the gate landed. Entries match on (path, rule, "
+                    "source-line text); editing a flagged line invalidates "
+                    "its entry. Regenerate deliberately with "
+                    "`python -m mx_rcnn_tpu.analysis --write-baseline`."),
+        "suppressions": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return len(entries)
+
+
+class Matcher:
+    """Mutable view over the baseline: each entry absorbs up to ``count``
+    findings; leftovers report as stale via ``unused()``."""
+
+    def __init__(self, entries: Iterable[dict]):
+        self._budget: Dict[Key, int] = {}
+        for e in entries:
+            k = (e["path"], e["rule"], e.get("text", ""))
+            self._budget[k] = self._budget.get(k, 0) + int(e.get("count", 1))
+
+    def consume(self, f: Finding) -> bool:
+        k = _key(f)
+        if self._budget.get(k, 0) > 0:
+            self._budget[k] -= 1
+            return True
+        return False
+
+    def unused(self) -> List[Key]:
+        return [k for k, n in self._budget.items() if n > 0]

@@ -1,0 +1,125 @@
+"""graftlint CLI: ``python -m mx_rcnn_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (baselined findings don't fail the gate), 1 live
+findings or stale baseline entries, 2 bad invocation. ``--write-baseline``
+adopts the current findings as the suppression file — a deliberate,
+diff-reviewed act, which is why there is no "auto-append" mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from mx_rcnn_tpu.analysis import baseline as baseline_mod
+from mx_rcnn_tpu.analysis import engine
+from mx_rcnn_tpu.analysis.settings import Settings, find_repo_root
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m mx_rcnn_tpu.analysis",
+        description=("graftlint — AST-based trace-safety and config-"
+                     "contract checks for this repo's JAX/TPU conventions"),
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: "
+                        "[tool.graftlint] paths)")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="baseline suppression file (default: "
+                        "[tool.graftlint] baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file entirely")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="adopt all current findings into the baseline "
+                        "file and exit 0")
+    p.add_argument("--disable", metavar="RULES", default=None,
+                   help="comma-separated rule names to skip for this run")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        from mx_rcnn_tpu.analysis.rules import ALL_RULES
+
+        for rule in ALL_RULES:
+            print(f"{rule.NAME:24s} {rule.RATIONALE}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else find_repo_root()
+    settings = Settings.load(root)
+    if args.disable:
+        settings = Settings(**{
+            **settings.__dict__,
+            "disable": settings.disable + tuple(
+                r.strip() for r in args.disable.split(",") if r.strip()),
+        })
+    paths = args.paths or list(settings.paths)
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(full):
+            print(f"graftlint: path not found: {p}", file=sys.stderr)
+            return 2
+
+    baseline_path = os.path.join(
+        root, args.baseline or settings.baseline)
+    all_entries = baseline_mod.load(baseline_path)
+    entries = ([] if (args.no_baseline or args.write_baseline)
+               else all_entries)
+
+    # Subset runs (explicit paths) must not judge — or clobber — baseline
+    # entries for files that were never linted.
+    scopes = [os.path.relpath(
+        p if os.path.isabs(p) else os.path.join(root, p),
+        root).replace(os.sep, "/") for p in paths]
+
+    def in_scope(rel_path: str) -> bool:
+        return any(s == "." or rel_path == s
+                   or rel_path.startswith(s.rstrip("/") + "/")
+                   for s in scopes)
+
+    result = engine.run(paths, root, settings, entries)
+
+    if args.write_baseline:
+        keep = [e for e in all_entries if not in_scope(e["path"])]
+        n = baseline_mod.write(baseline_path, result.findings, keep)
+        print(f"graftlint: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to "
+              f"{os.path.relpath(baseline_path, root)}")
+        return 0
+
+    for f in result.findings:
+        print(f.render())
+
+    # Entries outside the linted scope, or for rules switched off this
+    # run, are not judged stale — they simply weren't exercised.
+    matcher = baseline_mod.Matcher(
+        e for e in entries
+        if in_scope(e["path"]) and e["rule"] not in settings.disable)
+    for f in result.baselined + result.findings:
+        matcher.consume(f)
+    stale = matcher.unused()
+    for path, rule, text in stale:
+        print(f"{path}: [stale-baseline] entry no longer matches "
+              f"anything: [{rule}] {text!r}")
+
+    n, b = len(result.findings), len(result.baselined)
+    summary = (f"graftlint: {result.files_checked} files, "
+               f"{n} finding{'s' if n != 1 else ''}")
+    if b:
+        summary += f" ({b} baselined)"
+    if stale:
+        summary += f", {len(stale)} stale baseline entries"
+    print(summary)
+    return 1 if (result.findings or stale) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,212 @@
+"""graftlint engine: findings, rule registry, file walker, suppressions.
+
+The linter is deliberately dependency-free (stdlib ``ast`` + ``tokenize``
+only) and never imports jax or the package under analysis — the config
+contract is recovered by parsing ``config.py``'s AST (rules/cfg_contract.py),
+and trace-safety is a syntactic reachability analysis (tracing.py). That
+keeps ``python -m mx_rcnn_tpu.analysis`` startup at milliseconds and makes
+the pass runnable in any environment that can parse the sources.
+
+A rule is a module in ``mx_rcnn_tpu/analysis/rules/`` exposing::
+
+    NAME = "rule-name"          # kebab-case id used in reports/suppressions
+    RATIONALE = "one line"      # shown by --list-rules and in the README
+    def check(ctx) -> Iterator[Finding]: ...
+
+``ctx`` is a FileContext: parsed AST + source lines + lazily computed
+shared analyses (traced-function set). Findings can be silenced three ways,
+in priority order: an inline ``# graftlint: disable=rule-a,rule-b`` (or
+bare ``disable``) comment on the flagged line, a baseline entry
+(baseline.py), or disabling the rule in ``[tool.graftlint]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence
+
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?:=(?P<rules>[\w,\- ]+))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit. ``text`` is the stripped source line — it doubles as
+    the line-shift-tolerant baseline key (baseline.py matches on
+    (path, rule, text), not on line numbers)."""
+
+    path: str  # repo-relative posix path
+    rule: str
+    line: int
+    col: int
+    message: str
+    text: str = ""
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: [{self.rule}] {self.message}"
+        if self.text:
+            out += f"\n    {self.text}"
+        return out
+
+
+class FileContext:
+    """Per-file parse result + lazy shared analyses handed to every rule."""
+
+    def __init__(self, path: str, rel_path: str, source: str,
+                 tree: ast.AST, settings):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.settings = settings
+        self._traced = None
+        self._comments = None
+        # Parent links let rules walk outward (e.g. "is this node inside a
+        # loop / a traced function"); computed once per file.
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+    @property
+    def traced(self):
+        """tracing.TraceAnalysis for this file (computed on first use)."""
+        if self._traced is None:
+            from mx_rcnn_tpu.analysis import tracing
+
+            self._traced = tracing.TraceAnalysis(self.tree, self.parents)
+        return self._traced
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            path=self.rel_path,
+            rule=rule,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            text=self.line_text(getattr(node, "lineno", 0)),
+        )
+
+    def comment_on(self, lineno: int) -> str:
+        """The real COMMENT token on a line (tokenize, not regex over the
+        raw line — a string literal containing '# graftlint: disable'
+        must not suppress anything)."""
+        if self._comments is None:
+            self._comments = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.source).readline):
+                    if tok.type == tokenize.COMMENT:
+                        self._comments[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError):
+                pass  # parsed fine but untokenizable — no suppressions
+        return self._comments.get(lineno, "")
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        m = _DISABLE_RE.search(self.comment_on(finding.line))
+        if not m:
+            return False
+        rules = m.group("rules")
+        if rules is None:
+            return True
+        return finding.rule in {r.strip() for r in rules.split(",")}
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    files_checked: int = 0
+
+
+def iter_python_files(paths: Sequence[str], root: str,
+                      exclude: Sequence[str] = ()) -> Iterator[str]:
+    """Yield .py files under ``paths`` (files or directories), sorted,
+    skipping any whose repo-relative path starts with an exclude prefix."""
+
+    def excluded(p: str) -> bool:
+        rel = os.path.relpath(p, root).replace(os.sep, "/")
+        return any(rel == e or rel.startswith(e.rstrip("/") + "/")
+                   for e in exclude)
+
+    seen = set()  # overlapping path args must not lint a file twice
+
+    def emit(p: str):
+        key = os.path.abspath(p)
+        if key not in seen:
+            seen.add(key)
+            yield p
+
+    for path in paths:
+        path = os.path.join(root, path) if not os.path.isabs(path) else path
+        if os.path.isfile(path):
+            if path.endswith(".py") and not excluded(path):
+                yield from emit(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not excluded(
+                    os.path.join(dirpath, d)))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    fp = os.path.join(dirpath, fn)
+                    if not excluded(fp):
+                        yield from emit(fp)
+
+
+def lint_file(path: str, root: str, settings, rules) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return lint_source(source, rel, settings, rules, abs_path=path)
+
+
+def lint_source(source: str, rel_path: str, settings, rules,
+                abs_path: Optional[str] = None) -> List[Finding]:
+    """Lint one source blob; the API tests drive this directly."""
+    try:
+        tree = ast.parse(source, filename=rel_path)
+    except SyntaxError as exc:
+        return [Finding(path=rel_path, rule="syntax",
+                        line=exc.lineno or 0, col=(exc.offset or 0),
+                        message=f"syntax error: {exc.msg}")]
+    ctx = FileContext(abs_path or rel_path, rel_path, source, tree, settings)
+    out: List[Finding] = []
+    for rule in rules:
+        if rule.NAME in settings.disable:
+            continue
+        for f in rule.check(ctx):
+            if not ctx.is_suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.line, f.col, f.rule))
+    return out
+
+
+def run(paths: Sequence[str], root: str, settings,
+        baseline_entries=None) -> LintResult:
+    """Lint ``paths``, splitting findings into live vs baselined."""
+    from mx_rcnn_tpu.analysis import baseline as baseline_mod
+    from mx_rcnn_tpu.analysis.rules import ALL_RULES
+
+    result = LintResult()
+    matcher = baseline_mod.Matcher(baseline_entries or [])
+    for path in iter_python_files(paths, root, settings.exclude):
+        findings = lint_file(path, root, settings, ALL_RULES)
+        result.files_checked += 1
+        for f in findings:
+            (result.baselined if matcher.consume(f)
+             else result.findings).append(f)
+    return result

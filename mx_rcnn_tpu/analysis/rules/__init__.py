@@ -1,0 +1,25 @@
+"""graftlint rule catalog.
+
+Order is report order. Each module exposes NAME / RATIONALE / check(ctx);
+adding a rule = adding a module here and appending it to ALL_RULES.
+"""
+
+from mx_rcnn_tpu.analysis.rules import (
+    cfg_contract,
+    donation,
+    excepts,
+    host_sync,
+    prng,
+    shapes,
+)
+
+ALL_RULES = (
+    host_sync,
+    shapes,
+    donation,
+    prng,
+    cfg_contract,
+    excepts,
+)
+
+__all__ = ["ALL_RULES"]

@@ -1,0 +1,216 @@
+"""cfg-contract: every `cfg.<section>.<field>` chain must exist in config.py.
+
+The frozen dataclass tree in ``mx_rcnn_tpu/config.py`` is the single
+config contract — but attribute access is only checked when the line
+actually *runs*, which for rarely-taken branches means at trace time on a
+chip, minutes into a launch. A typo'd field (``cfg.train.rpn_batchsize``)
+or a field removed in a refactor is pure drift until then. This rule
+recovers the contract statically (parsing config.py's AST — the linter
+never imports the package) and resolves every attribute chain rooted at a
+config-typed name against it at lint time.
+
+Roots recognized: names in ``[tool.graftlint] cfg-roots`` (default
+``cfg``), parameters annotated with a known dataclass type (``def f(net:
+NetworkConfig)``), and one-hop section aliases (``train = cfg.train``).
+``getattr``/``replace`` and any dynamic access are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import FuncOrLambda, dotted_name
+
+NAME = "cfg-contract"
+RATIONALE = ("a typo'd/removed `cfg.section.field` only explodes at trace "
+             "time; resolve every chain against config.py's dataclass "
+             "tree at lint time")
+
+_CONFIG_CACHE: Dict[str, "Contract"] = {}
+
+
+class Contract:
+    """Field/property/method sets per dataclass, parsed from config.py."""
+
+    def __init__(self, config_path: str):
+        with open(config_path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=config_path)
+        #: class name -> {attr name -> annotation class name or None}
+        self.classes: Dict[str, Dict[str, Optional[str]]] = {}
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not any(_is_dataclass_deco(d) for d in node.decorator_list):
+                continue
+            attrs: Dict[str, Optional[str]] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name):
+                    attrs[stmt.target.id] = _annotation_class(
+                        stmt.annotation)
+                elif isinstance(stmt, ast.FunctionDef):
+                    attrs[stmt.name] = None  # property / method
+            self.classes[node.name] = attrs
+
+    def has(self, cls: str, attr: str) -> bool:
+        return attr in self.classes.get(cls, {})
+
+    def section_class(self, cls: str, attr: str) -> Optional[str]:
+        """The dataclass type of ``cls.attr`` if it is itself a section."""
+        target = self.classes.get(cls, {}).get(attr)
+        return target if target in self.classes else None
+
+    def attrs(self, cls: str) -> Set[str]:
+        return set(self.classes.get(cls, ()))
+
+
+def _is_dataclass_deco(deco: ast.AST) -> bool:
+    name = dotted_name(deco.func if isinstance(deco, ast.Call) else deco)
+    return name in ("dataclass", "dataclasses.dataclass",
+                    "struct.dataclass", "flax.struct.dataclass")
+
+
+def _annotation_class(ann: ast.AST) -> Optional[str]:
+    # NetworkConfig / "NetworkConfig" (string annotation) / Optional[...]
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.strip("'\"")
+    return None
+
+
+def _contract(ctx: FileContext) -> Optional[Contract]:
+    # analysis/rules/cfg_contract.py -> analysis/ -> mx_rcnn_tpu/config.py
+    path = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..", "config.py"))
+    if not os.path.isfile(path):
+        return None
+    if path not in _CONFIG_CACHE:
+        _CONFIG_CACHE[path] = Contract(path)
+    return _CONFIG_CACHE[path]
+
+
+ROOT_CLASS = "Config"
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    contract = _contract(ctx)
+    if contract is None or ROOT_CLASS not in contract.classes:
+        return
+    # Per-function (plus module scope, key None) typed-name tables:
+    # name -> dataclass class name.
+    typed: Dict[Optional[ast.AST], Dict[str, str]] = {}
+    for node in ast.walk(ctx.tree):
+        fn = ctx.traced.enclosing_function(node)
+        table = typed.setdefault(fn, {})
+        if isinstance(node, ast.arg):
+            cls = _annotation_class(node.annotation) if node.annotation \
+                else None
+            if cls in contract.classes:
+                # annotation attaches to the fn OWNING the arg, which is
+                # the parent, not enclosing_function(arg-node)'s parent
+                owner = ctx.parents.get(node)
+                while owner is not None and not isinstance(
+                        owner, FuncOrLambda):
+                    owner = ctx.parents.get(owner)
+                typed.setdefault(owner, {})[node.arg] = cls
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+            # one-hop alias: net = cfg.network
+            section = None
+            chain = _attr_chain(node.value)
+            if chain and len(chain) == 2:
+                root_cls = _root_class(ctx, contract, typed, fn, chain[0])
+                if root_cls:
+                    section = contract.section_class(root_cls, chain[1])
+            if section:
+                table[target] = section
+            elif target in ctx.settings.cfg_roots and not _looks_like_config(
+                    node.value, ctx.settings.cfg_roots):
+                # `cfg = json.load(...)` / `cfg = {...}` — a visible
+                # non-Config binding shadows the name-based assumption
+                # for this scope (empty string = "known not-Config").
+                table[target] = ""
+
+    emitted: Set[Tuple[int, int]] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        chain = _attr_chain(node)
+        if not chain or len(chain) < 2:
+            continue
+        key = (node.lineno, node.col_offset)
+        # only report the OUTERMOST attribute of a chain once
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.Attribute):
+            continue
+        fn = ctx.traced.enclosing_function(node)
+        root_cls = _root_class(ctx, contract, typed, fn, chain[0])
+        if not root_cls or key in emitted:
+            continue
+        emitted.add(key)
+        finding = _resolve_chain(ctx, contract, root_cls, chain, node)
+        if finding:
+            yield finding
+
+
+def _looks_like_config(value: ast.AST, cfg_roots) -> bool:
+    """Could ``value`` evaluate to the Config tree? Conservative: literals
+    and comprehensions cannot; calls/attributes keep the assumption when
+    anything in them mentions a cfg root or a *config*-named callable
+    (generate_config, Config, replace(cfg, ...), cfg.with_updates(...))."""
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.Tuple,
+                          ast.Constant, ast.ListComp, ast.SetComp,
+                          ast.DictComp, ast.GeneratorExp, ast.JoinedStr)):
+        return False
+    for n in ast.walk(value):
+        if isinstance(n, ast.Name) and (
+                n.id in cfg_roots or "config" in n.id.lower()
+                or n.id == "replace"):
+            return True
+        if isinstance(n, ast.Attribute) and "config" in n.attr.lower():
+            return True
+    return False
+
+
+def _root_class(ctx, contract, typed, fn, root_name: str) -> Optional[str]:
+    cur = fn
+    while True:
+        table = typed.get(cur)
+        if table and root_name in table:
+            return table[root_name] or None  # "" = shadowed non-Config
+        if cur is None:
+            break
+        cur = ctx.traced.enclosing_function(cur)
+    if root_name in ctx.settings.cfg_roots:
+        return ROOT_CLASS
+    return None
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """cfg.train.lr -> ['cfg', 'train', 'lr']; None if not a pure chain."""
+    name = dotted_name(node)
+    return name.split(".") if name else None
+
+
+def _resolve_chain(ctx: FileContext, contract: Contract, root_cls: str,
+                   chain: List[str], node: ast.AST) -> Optional[Finding]:
+    cls = root_cls
+    for i, attr in enumerate(chain[1:], start=1):
+        if not contract.has(cls, attr):
+            known = ", ".join(sorted(contract.attrs(cls))[:8])
+            return ctx.finding(
+                NAME, node,
+                f"`{'.'.join(chain[:i + 1])}` does not resolve: "
+                f"`{cls}` has no field `{attr}` (config.py; fields "
+                f"include: {known}, ...)")
+        nxt = contract.section_class(cls, attr)
+        if nxt is None:
+            return None  # reached a leaf; deeper attrs (.shape etc.) are
+            # on the VALUE, not the contract
+        cls = nxt
+    return None

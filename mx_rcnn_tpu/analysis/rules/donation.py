@@ -1,0 +1,93 @@
+"""missing-donation: jitted state-updating wrappers must donate the state.
+
+The convention set by ``train/step.py::make_train_step``: any ``jax.jit``
+of a function whose first parameter is the train-state pytree passes
+``donate_argnums=(0,)`` so XLA reuses the old state's buffers for the new
+state. Dropping donation silently DOUBLES the parameter+optimizer HBM
+footprint — invisible at toy sizes, an OOM at flagship sizes where the
+state is most of the chip's memory. Which parameter names count as "a
+state pytree" comes from ``[tool.graftlint] state-params`` (default:
+``state``, ``train_state``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import (
+    JIT_DONATABLE, FuncNode, jit_call_kwargs, jit_expr_name,
+)
+
+NAME = "missing-donation"
+RATIONALE = ("`jax.jit` of a state-first step function without "
+             "`donate_argnums` doubles the state's HBM footprint")
+
+_DONATE_KW = ("donate_argnums", "donate_argnames")
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            yield from _check_call(ctx, node)
+        elif isinstance(node, FuncNode):
+            yield from _check_decorators(ctx, node)
+
+
+def _check_call(ctx: FileContext, node: ast.Call) -> Iterator[Finding]:
+    name = jit_expr_name(node.func)
+    if name not in JIT_DONATABLE or not node.args:
+        return
+    # donate kwargs live on the jit call itself OR on the partial that
+    # configured it: `partial(jax.jit, donate_argnums=(0,))(step)`.
+    if _donates(jit_call_kwargs(node.func) + list(node.keywords)):
+        return
+    state_arg = _state_first_param(ctx, node.args[0], node)
+    if state_arg:
+        yield ctx.finding(
+            NAME, node,
+            f"`{name}` wraps a function whose first parameter "
+            f"`{state_arg}` is a state pytree but passes no "
+            "`donate_argnums` — the old state's buffers stay live "
+            "(convention: train/step.py)")
+
+
+def _check_decorators(ctx: FileContext, fn) -> Iterator[Finding]:
+    for deco in fn.decorator_list:
+        name = jit_expr_name(deco)
+        if name not in JIT_DONATABLE:
+            continue
+        if _donates(jit_call_kwargs(deco)):
+            continue
+        state_arg = _first_param_if_state(ctx, fn)
+        if state_arg:
+            yield ctx.finding(
+                NAME, deco if hasattr(deco, "lineno") else fn,
+                f"`@{name}` on `{fn.name}` (state-first parameter "
+                f"`{state_arg}`) without `donate_argnums`")
+
+
+def _donates(keywords) -> bool:
+    return any(k.arg in _DONATE_KW for k in keywords)
+
+
+def _state_first_param(ctx: FileContext, target: ast.AST,
+                       at_node: ast.AST) -> Optional[str]:
+    if isinstance(target, ast.Lambda):
+        return _first_param_if_state(ctx, target)
+    if isinstance(target, ast.Name):
+        resolved = ctx.traced._resolve(target.id, at_node)
+        if isinstance(resolved, FuncNode):
+            return _first_param_if_state(ctx, resolved)
+    return None  # unresolvable (imported / computed) — out of scope
+
+
+def _first_param_if_state(ctx: FileContext, fn) -> Optional[str]:
+    args = fn.args.posonlyargs + fn.args.args
+    if not args:
+        return None
+    first = args[0].arg
+    if first in ctx.settings.state_params or first.endswith("_state"):
+        return first
+    return None

@@ -1,0 +1,64 @@
+"""broad-except: `except Exception` / bare `except` swallow real failures.
+
+``train/checkpoint.py`` used to catch ``Exception`` around orbax restores
+— a checkpoint I/O failure (full disk, corrupt shard, layout mismatch)
+degraded into silently training from scratch. Broad handlers are allowed
+in exactly one syntactic position: an optional-dependency probe whose
+``try`` body contains only imports (the ``data/image.py`` PIL/cv2
+fallbacks). Everything else must name the exception types it means to
+handle, or carry an explicit ``# graftlint: disable=broad-except`` with a
+reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+
+NAME = "broad-except"
+RATIONALE = ("`except Exception:` turns checkpoint/IO failures into "
+             "silent wrong behavior; name the types or justify inline")
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        import_probe = all(_probe_stmt(s) for s in node.body)
+        for handler in node.handlers:
+            if not _is_broad(handler.type):
+                continue
+            if import_probe:
+                continue  # optional-dependency fallback
+            what = ("bare `except:`" if handler.type is None
+                    else f"`except {ast.unparse(handler.type)}:`")
+            yield ctx.finding(
+                NAME, handler,
+                f"{what} outside an import-probe swallows unrelated "
+                "failures — name the exception types (and log what was "
+                "lost)")
+
+
+def _probe_stmt(stmt: ast.stmt) -> bool:
+    """Imports plus trivial flag assignments (`_HAS_CV2 = True`) — the
+    optional-dependency probe shape; anything with a call is real work."""
+    if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        return True
+    if isinstance(stmt, ast.Assign):
+        return isinstance(stmt.value, (ast.Constant, ast.Name,
+                                       ast.Attribute))
+    return False
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(elt) for elt in type_node.elts)
+    return False

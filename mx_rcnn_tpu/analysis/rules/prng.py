@@ -1,0 +1,166 @@
+"""prng-key-reuse: one key, one consumption.
+
+``jax.random.*`` functions are deterministic in the key: feeding the same
+key to two sampling calls yields CORRELATED (often identical) draws — the
+classic silent statistics bug (rpn and rcnn sampling the same subset
+pattern, dropout masks repeating every step). The contract is linear: a
+key is consumed exactly once (``split`` / ``fold_in`` count as
+consumptions that retire it); fresh subkeys come from ``split``.
+
+The check is a per-function linear walk with two refinements: ``if``
+branches analyze from a copy of the consumed-set (uses on exclusive paths
+don't alias) and loop bodies are walked twice, so a key defined outside a
+loop but consumed inside it is caught as loop-carried reuse.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import FuncOrLambda, dotted_name
+
+NAME = "prng-key-reuse"
+RATIONALE = ("the same PRNG key fed to two `jax.random.*` calls yields "
+             "correlated draws; `split` before each use")
+
+#: jax.random attrs that do NOT consume a key argument
+_NON_CONSUMING = {"PRNGKey", "key", "key_data", "wrap_key_data"}
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, FuncOrLambda) and not isinstance(
+                node, ast.Lambda):
+            body = node.body
+            findings: List[Finding] = []
+            _walk_block(ctx, body, set(), findings, own_fn=node)
+            # the two-pass loop walk revisits calls; one report per site
+            seen = set()
+            for f in findings:
+                if (f.line, f.col) not in seen:
+                    seen.add((f.line, f.col))
+                    yield f
+
+
+def _consuming_calls(stmt: ast.AST, own_fn) -> List[Tuple[ast.Call, str]]:
+    """(call, key-name) for jax.random consumptions lexically in ``stmt``,
+    skipping nested function bodies (they get their own analysis)."""
+    out = []
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FuncOrLambda) and node is not own_fn:
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if not name:
+            continue
+        # jax.random.* / the common `import jax.random as jrandom` alias;
+        # deliberately NOT bare `random.` — that's the stdlib.
+        if not ((name.startswith("jax.random.")
+                 or name.startswith("jrandom."))
+                and name.rsplit(".", 1)[-1] not in _NON_CONSUMING):
+            continue
+        args = list(node.args)
+        key_arg = None
+        for kw in node.keywords:
+            if kw.arg in ("key", "seed"):
+                key_arg = kw.value
+        if key_arg is None and args:
+            key_arg = args[0]
+        if isinstance(key_arg, ast.Name):
+            out.append((node, key_arg.id))
+    return out
+
+
+def _assigned_names(stmt: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+    return names
+
+
+def _walk_block(ctx: FileContext, body, consumed: Set[str],
+                findings: List[Finding], own_fn) -> None:
+    for stmt in body:
+        if isinstance(stmt, FuncOrLambda):
+            continue
+        if isinstance(stmt, ast.If):
+            # the test runs FIRST; branches are exclusive alternatives
+            # starting from the post-test state
+            _consume_stmt(ctx, stmt.test, consumed, findings, own_fn)
+            base = set(consumed)
+            taken: List[Set[str]] = []
+            for branch in (stmt.body, stmt.orelse):
+                branch_consumed = set(base)
+                _walk_block(ctx, branch, branch_consumed, findings, own_fn)
+                taken.append(branch_consumed)
+            # flow join REPLACES the state: consumed-on-some-path stays
+            # consumed, but a key every branch rebound is fresh again.
+            consumed.clear()
+            consumed.update(taken[0] | taken[1])
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            # a For iter evaluates ONCE, before the loop; a While test
+            # re-evaluates every iteration. The second body pass exposes
+            # loop-carried reuse of an outer key.
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                _consume_stmt(ctx, stmt.iter, consumed, findings, own_fn)
+            for _ in range(2):
+                if isinstance(stmt, ast.While):
+                    _consume_stmt(ctx, stmt.test, consumed, findings,
+                                  own_fn)
+                _walk_block(ctx, stmt.body, consumed, findings, own_fn)
+                consumed -= _assigned_names(stmt)
+            _walk_block(ctx, stmt.orelse, consumed, findings, own_fn)
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            _walk_block(ctx, stmt.body, consumed, findings, own_fn)
+            continue
+        if isinstance(stmt, ast.Try):
+            # handlers are alternate outcomes of the body, not sequels:
+            # they analyze from the PRE-body state (like if/else), then
+            # everything merges for the code after the try.
+            base = set(consumed)
+            _walk_block(ctx, stmt.body, consumed, findings, own_fn)
+            _walk_block(ctx, stmt.orelse, consumed, findings, own_fn)
+            for handler in stmt.handlers:
+                handler_consumed = set(base)
+                _walk_block(ctx, handler.body, handler_consumed, findings,
+                            own_fn)
+                consumed |= handler_consumed
+            _walk_block(ctx, stmt.finalbody, consumed, findings, own_fn)
+            continue
+        _consume_stmt(ctx, stmt, consumed, findings, own_fn)
+
+
+def _consume_stmt(ctx: FileContext, stmt: ast.AST, consumed: Set[str],
+                  findings: List[Finding], own_fn) -> None:
+    seen_twice = set()
+    for call, key in _consuming_calls(stmt, own_fn):
+        if key in consumed:
+            if key not in seen_twice:
+                findings.append(ctx.finding(
+                    NAME, call,
+                    f"PRNG key `{key}` was already consumed by an earlier "
+                    "`jax.random` call — draws will be correlated; "
+                    "`jax.random.split` it first"))
+                seen_twice.add(key)
+        else:
+            consumed.add(key)
+    # assignments retire consumed marks for their targets
+    consumed.difference_update(_assigned_names(stmt))

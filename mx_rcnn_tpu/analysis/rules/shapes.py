@@ -1,0 +1,99 @@
+"""data-dependent-shape: dynamic result shapes inside traced code.
+
+``jnp.nonzero(x)`` / boolean-mask indexing produce arrays whose SHAPE
+depends on runtime values. Under jit that raises; with shape polymorphism
+or repeated retracing it becomes a TPU recompile bomb — each distinct
+count is a fresh XLA compile of the whole program (minutes at detector
+sizes). The repo's static-shape design rule (fixed max counts + validity
+masks, package docstring) exists precisely to avoid this; JAX's own
+escape hatch is the ``size=`` argument, which pins the output shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import dotted_name
+
+NAME = "data-dependent-shape"
+RATIONALE = ("`jnp.nonzero`/boolean-mask indexing without `size=` in "
+             "traced code forces per-count recompiles (use masks + fixed "
+             "budgets)")
+
+#: jnp calls whose output shape is value-dependent unless size= pins it
+_SIZED = {"nonzero", "flatnonzero", "argwhere", "unique"}
+_JNP_PREFIXES = ("jnp.", "jax.numpy.", "np.", "numpy.")
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    traced = ctx.traced
+    if not traced.traced:
+        return
+    # Map of name -> assigned-from-Compare, per nearest enclosing function,
+    # for the `mask = x > 0; y = x[mask]` spelling.
+    compare_names = _compare_assignments(ctx)
+    for node in ast.walk(ctx.tree):
+        if not traced.in_traced_code(node):
+            continue
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            base = name.rsplit(".", 1)[-1]
+            if (base in _SIZED
+                    and any(name == p + base for p in _JNP_PREFIXES)
+                    and not any(k.arg == "size" for k in node.keywords)):
+                yield ctx.finding(
+                    NAME, node,
+                    f"`{name}` without `size=` has a value-dependent "
+                    "output shape in traced code")
+            elif (name in ("jnp.where", "jax.numpy.where")
+                  and len(node.args) == 1
+                  and not any(k.arg == "size" for k in node.keywords)):
+                yield ctx.finding(
+                    NAME, node,
+                    "single-argument `jnp.where(cond)` is `nonzero` — "
+                    "value-dependent shape; pass `size=` or use the "
+                    "three-argument select form")
+        elif isinstance(node, ast.Subscript):
+            sl = node.slice
+            is_mask = isinstance(sl, ast.Compare) or (
+                isinstance(sl, ast.Name)
+                and _is_mask_at(compare_names,
+                                traced.enclosing_function(node),
+                                sl.id, node.lineno))
+            if is_mask:
+                yield ctx.finding(
+                    NAME, node,
+                    "boolean-mask indexing has a value-dependent output "
+                    "shape in traced code (use jnp.where masking or a "
+                    "fixed top-k budget)")
+
+
+def _compare_assignments(ctx: FileContext) -> Dict[ast.AST, list]:
+    """fn-node -> [(lineno, name, assigned-from-bare-Compare)], unsorted."""
+    out: Dict[ast.AST, list] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        fn = ctx.traced.enclosing_function(node)
+        flag = isinstance(node.value, ast.Compare)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.setdefault(fn, []).append((node.lineno, tgt.id, flag))
+    return out
+
+
+def _is_mask_at(compare_names: Dict[ast.AST, list], fn: ast.AST,
+                name: str, use_line: int) -> bool:
+    """Was ``name``'s LAST assignment before ``use_line`` a bare Compare?
+    (Position-sensitive: a mask rebound to something else after the use,
+    or a non-mask rebound to a Compare later, must not leak backwards.)"""
+    best = None
+    for lineno, nm, flag in compare_names.get(fn, ()):
+        if nm == name and lineno <= use_line:
+            if best is None or lineno > best[0]:
+                best = (lineno, flag)
+    return best[1] if best else False

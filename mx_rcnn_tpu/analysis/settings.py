@@ -1,0 +1,72 @@
+"""Declarative graftlint configuration: ``[tool.graftlint]`` in pyproject.
+
+The CLI takes paths/flags for ad-hoc runs, but the repo's own invocation
+(script/lint.sh, tests/test_lint_clean.py, pre-commit) is configured here
+so every entry point agrees on what "the lint gate" means. Python 3.11's
+``tomllib`` is preferred; 3.10 falls back to ``tomli``; if neither parser
+exists the defaults below (which mirror the committed pyproject) apply —
+the linter itself must never gain a hard dependency.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+try:  # pragma: no cover - version-dependent import
+    import tomllib as _toml
+except ImportError:  # pragma: no cover
+    try:
+        import tomli as _toml  # type: ignore[no-redef]
+    except ImportError:
+        _toml = None
+
+
+@dataclass(frozen=True)
+class Settings:
+    #: default lint targets when the CLI gets no paths
+    paths: Tuple[str, ...] = ("mx_rcnn_tpu", "tests")
+    #: repo-relative prefixes never linted (fixture snippets are not code)
+    exclude: Tuple[str, ...] = ()
+    #: baseline suppression file, repo-relative
+    baseline: str = ".graftlint-baseline.json"
+    #: rule NAMEs switched off entirely
+    disable: Tuple[str, ...] = ()
+    #: first-parameter names that mark a jitted function as holding a
+    #: donatable state pytree (rules/donation.py)
+    state_params: Tuple[str, ...] = ("state", "train_state")
+    #: variable names assumed to hold the frozen Config tree
+    cfg_roots: Tuple[str, ...] = ("cfg",)
+
+    @staticmethod
+    def load(root: str) -> "Settings":
+        path = os.path.join(root, "pyproject.toml")
+        if _toml is None or not os.path.isfile(path):
+            return Settings()
+        with open(path, "rb") as fh:
+            data = _toml.load(fh)
+        tool = data.get("tool", {}).get("graftlint", {})
+        kw = {}
+        for key, attr in (("paths", "paths"), ("exclude", "exclude"),
+                          ("disable", "disable"),
+                          ("state-params", "state_params"),
+                          ("cfg-roots", "cfg_roots")):
+            if key in tool:
+                kw[attr] = tuple(tool[key])
+        if "baseline" in tool:
+            kw["baseline"] = str(tool["baseline"])
+        return Settings(**kw)
+
+
+def find_repo_root(start: Optional[str] = None) -> str:
+    """Nearest ancestor holding pyproject.toml or .git; cwd otherwise."""
+    cur = os.path.abspath(start or os.getcwd())
+    while True:
+        if (os.path.isfile(os.path.join(cur, "pyproject.toml"))
+                or os.path.isdir(os.path.join(cur, ".git"))):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return os.path.abspath(start or os.getcwd())
+        cur = nxt

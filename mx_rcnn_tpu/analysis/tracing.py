@@ -1,0 +1,217 @@
+"""Which functions run under a JAX trace? — shared syntactic reachability.
+
+Trace roots are functions that are (a) decorated with ``@jax.jit`` /
+``@jit`` / ``@pjit`` / ``@partial(jax.jit, ...)``, (b) passed as the first
+positional argument to a ``jax.jit(...)`` / ``pjit(...)`` call, or (c)
+passed as the kernel to ``pl.pallas_call(...)``. From the roots the set
+closes transitively over *same-module* calls resolved lexically (enclosing
+function scopes outward to module level) — ``jax.jit(step)`` in
+``train/step.py`` marks ``step``, which marks the sibling closures
+``_one_update`` / ``_grads_of`` and the module-level ``_metric_parts``.
+
+Cross-module calls are NOT followed (no import resolution): a helper in
+``models/`` called only from a jitted wrapper in ``train/`` is invisible
+to the host-sync/shape rules unless its own module jits something. That
+under-approximation is deliberate — it keeps the pass flow-insensitive and
+false-positive-free on host-side helper code, and the conventions the
+linter enforces put the jit boundary and the traced helpers in the same
+module everywhere in this repo.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+FuncOrLambda = FuncNode + (ast.Lambda,)
+
+#: dotted names that wrap a python callable into a traced computation
+JIT_CALLABLES = {
+    "jit", "jax.jit", "pjit", "jax.pjit", "pjit.pjit",
+    "pallas_call", "pl.pallas_call", "pallas.pallas_call",
+    "checkify.checkify",
+}
+#: of those, the ones with jit's ``donate_argnums`` API (rules/donation.py)
+JIT_DONATABLE = {"jit", "jax.jit", "pjit", "jax.pjit", "pjit.pjit"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.random.split' for nested Attribute/Name, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def jit_expr_name(node: ast.AST) -> Optional[str]:
+    """If ``node`` evaluates to a jit-like wrapper, its dotted name.
+
+    Handles the bare callable (``jax.jit``) and the configured-partial
+    idiom (``partial(jax.jit, static_argnums=...)``).
+    """
+    name = dotted_name(node)
+    if name in JIT_CALLABLES:
+        return name
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in ("partial", "functools.partial") and node.args:
+            inner = dotted_name(node.args[0])
+            if inner in JIT_CALLABLES:
+                return inner
+    return None
+
+
+def jit_call_kwargs(node: ast.AST) -> List[ast.keyword]:
+    """Keywords carried by a jit-like expression (call or partial form)."""
+    if isinstance(node, ast.Call):
+        return list(node.keywords)
+    return []
+
+
+class _ScopeIndex(ast.NodeVisitor):
+    """function node -> chain of enclosing scopes, each a {name: def} map."""
+
+    def __init__(self):
+        self.scopes: List[Dict[str, ast.AST]] = [{}]
+        self.chain_of: Dict[ast.AST, Tuple[Dict[str, ast.AST], ...]] = {}
+        self.module_scope = self.scopes[0]
+
+    def _visit_func(self, node):
+        self.scopes[-1].setdefault(node.name, node)
+        self.chain_of[node] = tuple(self.scopes)
+        self.scopes.append({})
+        self.generic_visit(node)
+        self.scopes.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node):
+        self.chain_of[node] = tuple(self.scopes)
+        self.generic_visit(node)
+
+
+class TraceAnalysis:
+    def __init__(self, tree: ast.AST, parents: Dict[ast.AST, ast.AST]):
+        self.tree = tree
+        self.parents = parents
+        self._index = _ScopeIndex()
+        self._index.visit(tree)
+        self.traced: Set[ast.AST] = set()
+        self._find_roots()
+        self._close_over_calls()
+
+    # -- root discovery ----------------------------------------------------
+
+    def _find_roots(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, FuncNode):
+                for deco in node.decorator_list:
+                    if jit_expr_name(deco):
+                        self.traced.add(node)
+            elif isinstance(node, ast.Call) and jit_expr_name(node.func):
+                if node.args:
+                    target = node.args[0]
+                    # pallas_call(partial(kernel, ...), ...) — unwrap
+                    if (isinstance(target, ast.Call)
+                            and dotted_name(target.func)
+                            in ("partial", "functools.partial")
+                            and target.args):
+                        target = target.args[0]
+                    if isinstance(target, ast.Lambda):
+                        self.traced.add(target)
+                    elif isinstance(target, ast.Name):
+                        resolved = self._resolve(target.id, node)
+                        if resolved is not None:
+                            self.traced.add(resolved)
+
+    def _resolve(self, name: str, at_node: ast.AST) -> Optional[ast.AST]:
+        """Resolve ``name`` to a def lexically visible at ``at_node``."""
+        fn = self.enclosing_function(at_node)
+        while fn is not None:
+            chain = self._index.chain_of.get(fn, ())
+            # innermost first: the fn's own locals, then outward
+            for scope in (self._own_scope(fn),) + tuple(reversed(chain)):
+                if scope and name in scope:
+                    return scope[name]
+            fn = self.enclosing_function(fn)
+        if name in self._index.module_scope:
+            return self._index.module_scope[name]
+        return None
+
+    def _own_scope(self, fn: ast.AST) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for child in ast.walk(fn):
+            if child is fn or not isinstance(child, FuncNode):
+                continue
+            # only defs whose nearest enclosing function is fn
+            if self.enclosing_function(child) is fn:
+                out.setdefault(child.name, child)
+        return out
+
+    # -- transitive closure ------------------------------------------------
+
+    def _close_over_calls(self):
+        work = list(self.traced)
+        while work:
+            fn = work.pop()
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)):
+                    continue
+                resolved = self._resolve(node.func.id, node)
+                if (resolved is not None
+                        and isinstance(resolved, FuncNode)
+                        and resolved not in self.traced):
+                    self.traced.add(resolved)
+                    work.append(resolved)
+
+    # -- queries -----------------------------------------------------------
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, FuncOrLambda):
+            cur = self.parents.get(cur)
+        return cur
+
+    def in_traced_code(self, node: ast.AST) -> bool:
+        """True if any enclosing function is traced (nested defs inside a
+        traced function are traced: jit traces through closure calls)."""
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def traced_param_names(self, node: ast.AST) -> Set[str]:
+        """Parameter names of every enclosing traced function — the
+        syntactic stand-ins for 'traced values' at ``node``."""
+        names: Set[str] = set()
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced or self.in_traced_code(fn):
+                names |= param_names(fn)
+            fn = self.enclosing_function(fn)
+        return names
+
+    def iter_traced_functions(self) -> Iterator[ast.AST]:
+        return iter(self.traced)
+
+
+def param_names(fn: ast.AST) -> Set[str]:
+    if not isinstance(fn, FuncOrLambda):
+        return set()
+    a = fn.args
+    names = {p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names

@@ -189,7 +189,7 @@ class _PrefetchIterator:
                 self._next += 1
             try:
                 result = ("ok", self._make(self._indices[pos]))
-            except BaseException as exc:  # noqa: BLE001 — re-raised in consumer
+            except BaseException as exc:  # noqa: BLE001  # graftlint: disable=broad-except — captured and re-raised in the consumer, not swallowed
                 result = ("err", exc)
             with self._emit_cond:
                 # Preserve order: the consumer pops positions sequentially.

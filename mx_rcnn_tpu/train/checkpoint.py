@@ -178,14 +178,18 @@ def load_checkpoint(prefix: str, epoch: int, *, template=None,
     else:
         try:
             restored = ckptr.restore(path, item=item)
-        except Exception:
+        except (ValueError, KeyError, TypeError) as exc:
+            # orbax signals template/layout mismatches with these; OSError
+            # (missing/corrupt checkpoint) must propagate — resuming from
+            # scratch because the disk is unreadable is the silent-failure
+            # mode this narrowing exists to prevent.
             if item is not None and "opt_state" in item:
                 # Saved opt_state from an older optimizer layout — restore
                 # params only; the caller rebuilds the schedule via
                 # begin_step.
                 logger.warning(
                     "opt_state in %s does not match the current optimizer "
-                    "layout; restoring params only", path)
+                    "layout (%s); restoring params only", path, exc)
                 restored = _params_only(item)
             else:
                 raise
@@ -205,7 +209,13 @@ def _has_opt_state(path: str) -> bool:
         # versions return the tree mapping directly.
         tree = getattr(meta, "item_metadata", meta)
         return "opt_state" in tree
-    except Exception:
+    except (OSError, ValueError, KeyError, TypeError,
+            AttributeError) as exc:
+        # metadata API drift / unreadable metadata file — fall back to the
+        # directory layout, but say so: a checkpoint whose metadata cannot
+        # be read is worth a look before it bites at restore time.
+        logger.warning("could not read checkpoint metadata at %s (%s); "
+                       "probing directory layout instead", path, exc)
         return os.path.isdir(os.path.join(path, "opt_state"))
 
 

@@ -41,6 +41,9 @@ def save_vis(img_uint8: np.ndarray, dets: np.ndarray, class_names,
 
         Image.fromarray(vis).save(path)
         return True
-    except Exception as exc:  # pragma: no cover
+    except (ImportError, OSError, ValueError,
+            TypeError) as exc:  # pragma: no cover
+        # TypeError: PIL's "Cannot handle this data type" for non-uint8
+        # input — part of the best-effort False contract, not a crash.
         logger.warning("could not save visualization %s: %s", path, exc)
         return False

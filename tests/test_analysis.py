@@ -1,0 +1,657 @@
+"""graftlint rule fixtures: one must-flag and one near-miss per rule.
+
+These drive ``lint_source`` directly (no files, no subprocess) so each
+rule's positive/negative contract is pinned independently of the live
+tree's state. The live-tree gate is tests/test_lint_clean.py.
+"""
+
+import textwrap
+
+import pytest
+
+from mx_rcnn_tpu.analysis import Settings, lint_source
+from mx_rcnn_tpu.analysis.rules import ALL_RULES
+
+
+def lint(src, settings=None):
+    return lint_source(textwrap.dedent(src), "snippet.py",
+                       settings or Settings(), ALL_RULES)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+def test_host_sync_flags_item_inside_jit():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def f(params, x):
+            return x.sum().item()
+    """)
+    assert "host-sync-in-jit" in rules_of(findings)
+
+
+def test_host_sync_flags_float_of_traced_value():
+    findings = lint("""
+        import jax
+
+        def f(x):
+            y = x * 2
+            return float(y)
+
+        g = jax.jit(f)
+    """)
+    assert "host-sync-in-jit" in rules_of(findings)
+
+
+def test_host_sync_flags_np_asarray_and_device_get_in_traced_code():
+    findings = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            a = np.asarray(x)
+            b = jax.device_get(x)
+            return a, b
+    """)
+    assert sum(f.rule == "host-sync-in-jit" for f in findings) == 2
+
+
+def test_host_sync_near_miss_static_shape_idioms():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            n = int(x.shape[0])      # static under jit — fine
+            m = int(len(x))          # ditto
+            return x.reshape(n, m, -1)
+    """)
+    assert "host-sync-in-jit" not in rules_of(findings)
+
+
+def test_host_sync_near_miss_outside_jit_and_static_float():
+    findings = lint("""
+        import jax
+
+        def host_metric(arr):
+            return arr.sum().item()  # host code — fine
+
+        def make(cfg):
+            thresh = float(cfg.test.nms_thresh)  # static config — fine
+
+            @jax.jit
+            def f(x):
+                return x * thresh
+
+            return f
+    """)
+    assert "host-sync-in-jit" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# data-dependent-shape
+# ---------------------------------------------------------------------------
+
+def test_shape_flags_nonzero_without_size():
+    findings = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.nonzero(x > 0)
+    """)
+    assert "data-dependent-shape" in rules_of(findings)
+
+
+def test_shape_flags_boolean_mask_indexing():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            mask = x > 0
+            return x[mask] + x[x > 1]
+    """)
+    assert sum(f.rule == "data-dependent-shape" for f in findings) == 2
+
+
+def test_shape_mask_tracking_is_position_sensitive():
+    findings = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            mask = x > 0
+            y = x[mask]              # mask IS a compare here -> flag
+            mask = jnp.argmax(x)
+            z = x[mask]              # integer index now -> no flag
+            return y, z
+    """)
+    hits = [f for f in findings if f.rule == "data-dependent-shape"]
+    assert len(hits) == 1 and hits[0].text.startswith("y =")
+
+
+def test_shape_near_miss_sized_nonzero_and_host_code():
+    findings = lint("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            idx = jnp.nonzero(x > 0, size=128, fill_value=-1)
+            sel = jnp.where(x > 0, x, 0.0)  # 3-arg select — fine
+            return idx, sel
+
+        def host(arr):
+            return np.nonzero(arr)  # host code — fine
+    """)
+    assert "data-dependent-shape" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# missing-donation
+# ---------------------------------------------------------------------------
+
+def test_donation_flags_state_step_without_donate():
+    findings = lint("""
+        import jax
+
+        def make(model):
+            def step(state, batch, rng):
+                return state
+
+            return jax.jit(step)
+    """)
+    assert "missing-donation" in rules_of(findings)
+
+
+def test_donation_flags_decorator_form():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def step(train_state, batch):
+            return train_state
+    """)
+    assert "missing-donation" in rules_of(findings)
+
+
+def test_donation_near_miss_partial_call_form():
+    findings = lint("""
+        import jax
+        from functools import partial
+
+        def make():
+            def step(state, batch):
+                return state
+
+            return partial(jax.jit, donate_argnums=(0,))(step)
+    """)
+    assert "missing-donation" not in rules_of(findings)
+
+
+def test_donation_near_miss_donated_or_stateless():
+    findings = lint("""
+        import jax
+        from functools import partial
+
+        def make():
+            def step(state, batch, rng):
+                return state
+
+            def predict(params, image):
+                return params, image
+
+            a = jax.jit(step, donate_argnums=(0,))
+            b = jax.jit(step, donate_argnums=(0,) if True else ())
+            c = jax.jit(predict)  # params-first inference — no convention
+            return a, b, c
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step2(state, batch):
+            return state
+    """)
+    assert "missing-donation" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# prng-key-reuse
+# ---------------------------------------------------------------------------
+
+def test_prng_flags_double_consumption():
+    findings = lint("""
+        import jax
+
+        def sample(key):
+            a = jax.random.uniform(key, (3,))
+            b = jax.random.normal(key, (3,))
+            return a + b
+    """)
+    assert "prng-key-reuse" in rules_of(findings)
+
+
+def test_prng_flags_use_after_split():
+    findings = lint("""
+        import jax
+
+        def sample(key):
+            k1, k2 = jax.random.split(key)
+            return jax.random.uniform(key)  # key retired by split
+    """)
+    assert "prng-key-reuse" in rules_of(findings)
+
+
+def test_prng_flags_loop_carried_reuse():
+    findings = lint("""
+        import jax
+
+        def sample(key, n):
+            out = []
+            for _ in range(n):
+                out.append(jax.random.uniform(key))
+            return out
+    """)
+    assert "prng-key-reuse" in rules_of(findings)
+
+
+def test_prng_near_miss_split_and_carried_key():
+    findings = lint("""
+        import jax
+
+        def sample(key, n):
+            key, k1, k2 = jax.random.split(key, 3)
+            a = jax.random.uniform(k1)
+            b = jax.random.normal(k2)
+            out = []
+            for i in range(n):
+                key, sub = jax.random.split(key)
+                out.append(jax.random.uniform(sub))
+            keys = jax.random.split(key, n)
+            c = [jax.random.uniform(keys[i]) for i in range(n)]
+            return a, b, out, c
+    """)
+    assert "prng-key-reuse" not in rules_of(findings)
+
+
+def test_prng_loop_reuse_reported_once_per_site():
+    findings = lint("""
+        import jax
+
+        def sample(key, n):
+            for i in range(n):
+                a = jax.random.normal(key)
+                b = jax.random.normal(key)
+            return a, b
+    """)
+    hits = [f for f in findings if f.rule == "prng-key-reuse"]
+    # one per defective call site, not duplicated by the two-pass loop walk
+    assert len(hits) == len({(f.line, f.col) for f in hits}) == 2
+
+
+def test_prng_near_miss_exclusive_branches():
+    findings = lint("""
+        import jax
+
+        def sample(key, flip):
+            if flip:
+                return jax.random.uniform(key)
+            else:
+                return jax.random.normal(key)
+    """)
+    assert "prng-key-reuse" not in rules_of(findings)
+
+
+def test_prng_near_miss_key_rebound_in_both_branches():
+    findings = lint("""
+        import jax
+
+        def sample(key, c, bank):
+            x = jax.random.normal(key)
+            if c:
+                key = bank.fresh(1)
+            else:
+                key = bank.fresh(2)
+            return x + jax.random.normal(key)  # fresh on every path
+    """)
+    assert "prng-key-reuse" not in rules_of(findings)
+
+
+def test_prng_near_miss_try_except_alternate_outcomes():
+    findings = lint("""
+        import jax
+
+        def sample(key):
+            try:
+                return jax.random.uniform(key)
+            except ValueError:
+                return jax.random.normal(key)
+    """)
+    assert "prng-key-reuse" not in rules_of(findings)
+
+
+def test_prng_flags_reuse_after_if_test_consumption():
+    findings = lint("""
+        import jax
+
+        def sample(key):
+            if jax.random.bernoulli(key):
+                return jax.random.uniform(key)
+            return 0.0
+    """)
+    hits = [f for f in findings if f.rule == "prng-key-reuse"]
+    # the reuse site is the BODY call, not the test
+    assert len(hits) == 1 and "uniform" in hits[0].text
+
+
+def test_prng_flags_consumption_in_while_header():
+    findings = lint("""
+        import jax
+
+        def sample(key):
+            a = jax.random.uniform(key)
+            while jax.random.bernoulli(key):
+                pass
+            return a
+    """)
+    assert "prng-key-reuse" in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# cfg-contract
+# ---------------------------------------------------------------------------
+
+def test_cfg_contract_flags_misspelled_field():
+    # The acceptance fixture: rpn_batchsize vs the real rpn_batch_size.
+    findings = lint("""
+        def assign(cfg):
+            return cfg.train.rpn_batchsize
+    """)
+    hits = [f for f in findings if f.rule == "cfg-contract"]
+    assert hits and "rpn_batchsize" in hits[0].message
+
+
+def test_cfg_contract_flags_unknown_section_and_alias_typo():
+    findings = lint("""
+        def f(cfg):
+            a = cfg.trian.lr          # section typo
+            net = cfg.network
+            b = net.deepth            # alias field typo
+            return a, b
+    """)
+    assert sum(f.rule == "cfg-contract" for f in findings) == 2
+
+
+def test_cfg_contract_flags_annotated_param():
+    findings = lint("""
+        from mx_rcnn_tpu.config import NetworkConfig
+
+        def f(net: NetworkConfig):
+            return net.rio_pool_size  # typo of roi_pool_size
+    """)
+    assert "cfg-contract" in rules_of(findings)
+
+
+def test_cfg_contract_near_miss_valid_chains():
+    findings = lint("""
+        def f(cfg):
+            a = cfg.train.rpn_batch_size
+            b = cfg.network.num_anchors      # property
+            c = cfg.with_updates(seed=1)     # method
+            d = cfg.train.bbox_stds[0]
+            net = cfg.network
+            e = net.roi_pool_size
+            f_ = cfg.image.pad_shape
+            return a, b, c, d, e, f_
+    """)
+    assert "cfg-contract" not in rules_of(findings)
+
+
+def test_cfg_contract_shadowed_cfg_binding_is_exempt():
+    findings = lint("""
+        import json
+
+        def f(path):
+            cfg = json.load(open(path))   # visibly NOT the Config tree
+            return cfg.get("train")
+
+        def g():
+            cfg = {"train": 1}
+            return cfg.items()
+    """)
+    assert "cfg-contract" not in rules_of(findings)
+
+
+def test_cfg_contract_ignores_unrelated_names():
+    findings = lint("""
+        def f(other):
+            return other.train.rpn_batchsize  # not a cfg root
+    """)
+    assert "cfg-contract" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# broad-except
+# ---------------------------------------------------------------------------
+
+def test_broad_except_flags_handler_around_work():
+    findings = lint("""
+        def load(path):
+            try:
+                return open(path).read()
+            except Exception:
+                return None
+    """)
+    assert "broad-except" in rules_of(findings)
+
+
+def test_broad_except_flags_bare_except():
+    findings = lint("""
+        def load(path):
+            try:
+                return open(path).read()
+            except:
+                return None
+    """)
+    assert "broad-except" in rules_of(findings)
+
+
+def test_broad_except_near_miss_import_probe_and_named_types():
+    findings = lint("""
+        try:
+            import cv2
+            _HAS_CV2 = True
+        except Exception:
+            _HAS_CV2 = False
+
+        def load(path):
+            try:
+                return open(path).read()
+            except (OSError, ValueError):
+                return None
+    """)
+    assert "broad-except" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics: suppressions, baseline, syntax errors
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_silences_only_named_rule():
+    findings = lint("""
+        def load(path):
+            try:
+                return open(path).read()
+            except Exception:  # graftlint: disable=broad-except — forwarded
+                return None
+    """)
+    assert "broad-except" not in rules_of(findings)
+
+
+def test_inline_suppression_other_rule_does_not_silence():
+    findings = lint("""
+        def load(path):
+            try:
+                return open(path).read()
+            except Exception:  # graftlint: disable=prng-key-reuse
+                return None
+    """)
+    assert "broad-except" in rules_of(findings)
+
+
+def test_disable_marker_inside_string_literal_does_not_suppress():
+    findings = lint("""
+        def load(path):
+            try:
+                return open(path).read()
+            except Exception: doc = "# graftlint: disable=broad-except"
+    """)
+    assert "broad-except" in rules_of(findings)
+
+
+def test_overlapping_paths_lint_each_file_once(tmp_path):
+    from mx_rcnn_tpu.analysis.engine import iter_python_files
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("x = 1\n")
+    files = list(iter_python_files(["pkg", "pkg/m.py"], str(tmp_path)))
+    assert len(files) == 1
+
+
+def test_baseline_matcher_absorbs_and_reports_stale():
+    from mx_rcnn_tpu.analysis import baseline as bl
+    from mx_rcnn_tpu.analysis.engine import Finding
+
+    f = Finding(path="a.py", rule="broad-except", line=3, col=1,
+                message="m", text="except Exception:")
+    matcher = bl.Matcher([
+        {"path": "a.py", "rule": "broad-except",
+         "text": "except Exception:", "count": 1},
+        {"path": "gone.py", "rule": "broad-except", "text": "x", "count": 1},
+    ])
+    assert matcher.consume(f)
+    assert not matcher.consume(f)  # budget exhausted
+    assert ("gone.py", "broad-except", "x") in matcher.unused()
+
+
+def test_baseline_matches_on_text_not_line():
+    from mx_rcnn_tpu.analysis import baseline as bl
+    from mx_rcnn_tpu.analysis.engine import Finding
+
+    matcher = bl.Matcher([{"path": "a.py", "rule": "broad-except",
+                           "text": "except Exception:", "count": 1}])
+    shifted = Finding(path="a.py", rule="broad-except", line=99, col=1,
+                      message="m", text="except Exception:")
+    assert matcher.consume(shifted)
+
+
+def test_syntax_error_reports_as_finding():
+    findings = lint("def broken(:\n")
+    assert rules_of(findings) == {"syntax"}
+
+
+def test_disabled_rule_is_skipped():
+    findings = lint("""
+        def load(path):
+            try:
+                return open(path).read()
+            except Exception:
+                return None
+    """, settings=Settings(disable=("broad-except",)))
+    assert findings == []
+
+
+@pytest.fixture
+def mini_repo(tmp_path):
+    """A throwaway lint root: clean a.py, violating b.py, baseline for b."""
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.graftlint]
+        paths = ["a.py", "b.py"]
+        baseline = "bl.json"
+    """))
+    (tmp_path / "a.py").write_text("def ok():\n    return 1\n")
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        def load(path):
+            try:
+                return open(path).read()
+            except Exception:
+                return None
+    """))
+    import mx_rcnn_tpu.analysis.cli as cli
+
+    assert cli.main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    return tmp_path
+
+
+def test_cli_subset_run_does_not_report_out_of_scope_stale(mini_repo, capsys):
+    import mx_rcnn_tpu.analysis.cli as cli
+
+    # b.py's baseline entry is out of scope for a subset run over a.py
+    assert cli.main(["--root", str(mini_repo), "a.py"]) == 0
+    assert "stale" not in capsys.readouterr().out
+
+
+def test_cli_disabled_rule_baseline_entries_are_not_stale(mini_repo, capsys):
+    import mx_rcnn_tpu.analysis.cli as cli
+
+    # b.py's broad-except entry is unexercised when the rule is off —
+    # that is not staleness, and must not fail the gate
+    assert cli.main(["--root", str(mini_repo),
+                     "--disable", "broad-except"]) == 0
+    assert "stale" not in capsys.readouterr().out
+
+
+def test_cli_subset_write_baseline_keeps_out_of_scope_entries(mini_repo):
+    import json
+
+    import mx_rcnn_tpu.analysis.cli as cli
+
+    assert cli.main(["--root", str(mini_repo), "a.py",
+                     "--write-baseline"]) == 0
+    data = json.loads((mini_repo / "bl.json").read_text())
+    assert [e["path"] for e in data["suppressions"]] == ["b.py"]
+    # and the full run still passes against the merged baseline
+    assert cli.main(["--root", str(mini_repo)]) == 0
+
+
+def test_transitive_trace_closure_reaches_helpers():
+    findings = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            return jnp.nonzero(x)  # traced via caller
+
+        def caller(x):
+            return helper(x)
+
+        f = jax.jit(caller)
+    """)
+    assert "data-dependent-shape" in rules_of(findings)
+
+
+def test_pallas_kernel_via_partial_is_traced():
+    findings = lint("""
+        from functools import partial
+        import jax.experimental.pallas as pl
+        import numpy as np
+
+        def kernel(x_ref, o_ref, scale):
+            o_ref[...] = np.asarray(x_ref[...]) * scale
+
+        def run(x):
+            return pl.pallas_call(partial(kernel, scale=2.0),
+                                  out_shape=None)(x)
+    """)
+    assert "host-sync-in-jit" in rules_of(findings)

@@ -1,0 +1,54 @@
+"""The lint gate: graftlint reports zero non-baselined findings live.
+
+Two layers: the in-process API run (fast, precise failure output listing
+each finding) and the real CLI invocation (pins the exit-code contract
+that script/lint.sh and pre-commit rely on). Both are tier-1 — from this
+PR on, introducing a host sync inside jit, a donation drop, a config
+typo, or a swallowed exception fails the test suite, not a launch.
+"""
+
+import os
+import subprocess
+import sys
+
+from mx_rcnn_tpu.analysis import Settings, run
+from mx_rcnn_tpu.analysis import baseline as baseline_mod
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_live_tree_is_lint_clean():
+    settings = Settings.load(REPO_ROOT)
+    entries = baseline_mod.load(os.path.join(REPO_ROOT, settings.baseline))
+    result = run(settings.paths, REPO_ROOT, settings, entries)
+    assert result.files_checked > 50  # the walker actually saw the tree
+    rendered = "\n".join(f.render() for f in result.findings)
+    assert not result.findings, (
+        f"graftlint found {len(result.findings)} non-baselined "
+        f"finding(s):\n{rendered}\n\nFix them, or (for pre-existing debt "
+        "only) adopt deliberately via "
+        "`python -m mx_rcnn_tpu.analysis --write-baseline`.")
+
+
+def test_baseline_has_no_stale_entries():
+    settings = Settings.load(REPO_ROOT)
+    entries = baseline_mod.load(os.path.join(REPO_ROOT, settings.baseline))
+    result = run(settings.paths, REPO_ROOT, settings, entries)
+    matcher = baseline_mod.Matcher(entries)
+    for f in result.baselined:
+        matcher.consume(f)
+    assert not matcher.unused(), (
+        "stale baseline entries (the flagged lines were fixed or edited) — "
+        f"prune them: {matcher.unused()}")
+
+
+def test_cli_exits_zero_on_live_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mx_rcnn_tpu.analysis",
+         "mx_rcnn_tpu", "tests"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"`python -m mx_rcnn_tpu.analysis mx_rcnn_tpu tests` exited "
+        f"{proc.returncode}:\n{proc.stdout}\n{proc.stderr}")
+    assert "0 findings" in proc.stdout
