@@ -24,6 +24,11 @@ MFU: analytic FLOPs from XLA's own cost model for the whole compiled
 program (fwd+bwd+update, x8 for msd8), divided by the v5e bf16 peak
 (197 TFLOP/s/chip).
 
+graftscope: every run also writes an event stream + folded summary to
+MX_RCNN_BENCH_OBS (default ./bench_obs) — per-config `bench` events plus
+every XLA compile the run triggered, folded by obs/report.py into
+bench_obs/report.json (the printed line carries its path).
+
 The reference never published throughput (BASELINE.md: Speedometer logs
 only), so vs_baseline is measured against a fixed reference point of
 5.0 img/s/GPU — a generous estimate of the classic implementation's
@@ -208,6 +213,17 @@ def bench_eval_config(cfg, batch_size: int = 4, reps: int = 5,
 
 def main():
     from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.obs import compile_track, open_event_log, run_meta_fields
+    from mx_rcnn_tpu.obs import report as obs_report
+
+    # graftscope: the bench emits its measurements (and every XLA compile
+    # it triggers) as events, then folds them into <obs_dir>/report.json —
+    # the machine-readable artifact alongside the printed JSON line
+    # (PERF.md). Override the directory with MX_RCNN_BENCH_OBS.
+    obs_dir = os.environ.get("MX_RCNN_BENCH_OBS", "bench_obs")
+    elog = open_event_log(obs_dir, fresh=True)  # per-run artifact
+    elog.emit("run_meta", **run_meta_fields(None, tool="bench"))
+    compile_track.activate(elog)
 
     # Flagship shapes: (600,1000)-scale COCO canvas padded to 640x1024,
     # full train proposal path. All five BASELINE families; C4 and FPN at
@@ -260,6 +276,7 @@ def main():
                 break
             except Exception as e:  # record, don't lose the whole run
                 detail[name] = {"error": f"{type(e).__name__}: {e}"}
+        elog.emit("bench", config=name, **detail[name])
 
     # Inference path (SURVEY §4.2 call stack: test.py → Predictor →
     # pred_eval): the jitted detect program at the test proposal budget.
@@ -276,6 +293,7 @@ def main():
                 break
             except Exception as e:
                 detail[name] = {"error": f"{type(e).__name__}: {e}"}
+        elog.emit("bench", config=name, **detail[name])
 
     # Headline: best C4 recipe — same model, same shapes, same work per
     # optimizer step across recipes.
@@ -287,6 +305,18 @@ def main():
         headline_mfu = c4[headline_config].get("mfu")
     else:  # every C4 attempt hit a relay error — still emit the line
         headline_config, headline, headline_mfu = "error", 0.0, None
+
+    compile_track.deactivate()
+    elog.close()
+    summary = obs_report.summarize(obs_report.load_events(elog.path))
+    report_path = os.path.join(obs_dir, "report.json")
+    with open(report_path, "w", encoding="utf-8") as fh:
+        # the BENCH-compatible blob (top-level value/compile_count/...,
+        # full summary under "detail") — what regression gates diff.
+        json.dump(obs_report.bench_blob(summary), fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+
     print(json.dumps({
         "metric": "faster_rcnn_r101_coco_train_img_per_sec_per_chip",
         "value": headline,
@@ -299,6 +329,9 @@ def main():
                                 "reference publishes no throughput — "
                                 "BASELINE.md). MFU is the measured number."),
         "headline_config": headline_config,
+        # graftscope artifact: the same run folded by obs/report.py
+        # (compile count/time for the whole bench, per-config rows).
+        "obs_report": report_path,
         "detail": detail,
     }))
 

@@ -9,6 +9,7 @@ from mx_rcnn_tpu.analysis.rules import (
     donation,
     excepts,
     host_sync,
+    obs_schema,
     prng,
     shapes,
 )
@@ -20,6 +21,7 @@ ALL_RULES = (
     prng,
     cfg_contract,
     excepts,
+    obs_schema,
 )
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,112 @@
+"""obs-event-schema: EventLog.emit calls must use known, literal types.
+
+The graftscope event stream (``mx_rcnn_tpu/obs/events.py``) is a CLOSED
+schema: ``EVENT_TYPES`` enumerates every record kind, and ``obs.report``
+folds a run by those kinds. A typo'd type (``"stepp"``) raises only when
+that line runs — for rarely-taken branches (crash/stall paths,
+exactly the ones that matter) that means never in CI and once, fatally,
+mid-incident. A non-literal type key defeats both this rule and the
+schema's reviewability. Like cfg-contract, the schema is recovered from
+the source AST — the linter never imports the package.
+
+Recognized emitters (syntactic): an ``.emit(...)`` call whose receiver's
+final name segment is one of ``obs``, ``obs_log``, ``event_log``,
+``elog``, ``log``, or ends in ``_obs``/``_event_log`` — the repo's
+naming convention for EventLog bindings. ``logging.Handler.emit(record)``
+style calls land on receivers named ``handler``/``h`` and are out of
+scope (and ``logging.Logger`` has no ``emit`` at all).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, Optional, Set
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import dotted_name
+
+NAME = "obs-event-schema"
+RATIONALE = ("a typo'd or computed EventLog.emit record type only explodes "
+             "when that (often rarely-taken) line runs; resolve it against "
+             "obs/events.py::EVENT_TYPES at lint time")
+
+#: receiver name segments treated as EventLog bindings
+_EMITTER_NAMES = frozenset({"obs", "obs_log", "event_log", "elog", "log"})
+_EMITTER_SUFFIXES = ("_obs", "_event_log", "_elog")
+
+_SCHEMA_CACHE: dict = {}
+
+
+def _events_path() -> str:
+    # analysis/rules/obs_schema.py -> analysis/ -> mx_rcnn_tpu/obs/events.py
+    return os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "..", "obs", "events.py"))
+
+
+def _schema() -> Optional[Set[str]]:
+    """EVENT_TYPES parsed from obs/events.py's AST (cached)."""
+    path = _events_path()
+    if path in _SCHEMA_CACHE:
+        return _SCHEMA_CACHE[path]
+    types: Optional[Set[str]] = None
+    if os.path.isfile(path):
+        with open(path, "r", encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                tree = None
+        if tree is not None:
+            for node in tree.body:
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == "EVENT_TYPES"
+                        and isinstance(node.value, (ast.Tuple, ast.List))):
+                    types = {elt.value for elt in node.value.elts
+                             if isinstance(elt, ast.Constant)
+                             and isinstance(elt.value, str)}
+    _SCHEMA_CACHE[path] = types
+    return types
+
+
+def _is_emitter(receiver: Optional[str]) -> bool:
+    if not receiver:
+        return False
+    base = receiver.rsplit(".", 1)[-1]
+    return base in _EMITTER_NAMES or base.endswith(_EMITTER_SUFFIXES)
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    schema = _schema()
+    if not schema:
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"):
+            continue
+        if not _is_emitter(dotted_name(node.func.value)):
+            continue
+        if not node.args:
+            yield ctx.finding(
+                NAME, node,
+                "EventLog.emit needs the record type as its first "
+                "positional argument")
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            yield ctx.finding(
+                NAME, node,
+                "EventLog.emit record type must be a string LITERAL so "
+                "the schema is checkable at lint time (got "
+                f"`{ast.unparse(first)}`)")
+            continue
+        if first.value not in schema:
+            yield ctx.finding(
+                NAME, node,
+                f"unknown event type {first.value!r}; the graftscope "
+                f"schema (obs/events.py::EVENT_TYPES) is "
+                f"{tuple(sorted(schema))}")

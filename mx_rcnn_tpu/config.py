@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field, replace
-from typing import Any, Mapping
+from typing import Any, Mapping, Optional
 
 
 @dataclass(frozen=True)
@@ -141,10 +141,12 @@ class TrainConfig:
     fg_fraction: float = 0.25
     fg_thresh: float = 0.5
     bg_thresh_hi: float = 0.5
-    bg_thresh_lo: float = 0.0
-    # NOTE: the reference uses bg_thresh_lo=0.1 for the Fast-RCNN path and 0.0
-    # for end2end; end2end default kept here. tools/stages.py::train_rcnn
-    # applies the 0.1 Fast-RCNN preset for the alternate pipeline.
+    # None is a SENTINEL meaning "unset": it resolves to the reference's
+    # end2end default 0.0 (see bg_thresh_lo_value), while the alternate
+    # Fast-RCNN path (tools/stages.py::train_rcnn) replaces it with the
+    # reference's 0.1 preset. An EXPLICIT value — including 0.0, which the
+    # sentinel makes expressible — is respected everywhere.
+    bg_thresh_lo: Optional[float] = None
     # bbox regression target normalization (reference: config.TRAIN.BBOX_*).
     bbox_normalization_precomputed: bool = True
     bbox_means: tuple = (0.0, 0.0, 0.0, 0.0)
@@ -219,6 +221,13 @@ class TrainConfig:
     detr_aux_loss: bool = True
     # end2end switch retained for the alternate-training tools.
     end2end: bool = True
+
+    @property
+    def bg_thresh_lo_value(self) -> float:
+        """bg_thresh_lo with the None sentinel resolved to the end2end
+        default (0.0). Model forwards read this; only the Fast-RCNN stage
+        driver inspects the raw sentinel."""
+        return 0.0 if self.bg_thresh_lo is None else self.bg_thresh_lo
 
 
 @dataclass(frozen=True)
@@ -298,6 +307,33 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """graftscope telemetry (mx_rcnn_tpu/obs — event stream, step timing,
+    compile tracking, stall watchdog). Off by default: the disabled path
+    is a no-op sink and adds nothing to the train hot path."""
+
+    enabled: bool = False
+    # Event-log directory; "" derives one from the run (fit_detector uses
+    # "<checkpoint-prefix>.obs"). Each process writes its own JSONL.
+    dir: str = ""
+    # step/compile records buffer this many lines before hitting disk
+    # (other record kinds flush immediately).
+    flush_every: int = 64
+    # Emit a `compile` event (with shape signature) per XLA compile via
+    # jax.monitoring.
+    track_compiles: bool = True
+    # Heartbeat watchdog: emit a `stall` event (with stack dumps) when no
+    # step completes within max(stall_min_s, stall_factor x trailing
+    # median step time). Before the FIRST completed step the floor is
+    # COLD_GRACE (10x) x stall_min_s, so a healthy multi-minute cold
+    # compile is not reported as a stall (obs/watchdog.py).
+    watchdog: bool = True
+    stall_factor: float = 10.0
+    stall_min_s: float = 120.0
+    watchdog_poll_s: float = 5.0
+
+
+@dataclass(frozen=True)
 class Config:
     network: NetworkConfig = field(default_factory=NetworkConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
@@ -305,6 +341,7 @@ class Config:
     dataset: DatasetConfig = field(default_factory=DatasetConfig)
     image: ImageConfig = field(default_factory=ImageConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     seed: int = 0
 
     def with_updates(self, **kw) -> "Config":

@@ -116,7 +116,8 @@ def _split_packed(packed: np.ndarray, scale: float) -> List[np.ndarray]:
 def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
               vis: bool = False, thresh: float = 0.0,
               out_json: Optional[str] = None,
-              vis_dir: str = "vis", pipeline_depth: int = 3) -> Dict[str, float]:
+              vis_dir: str = "vis", pipeline_depth: int = 3,
+              event_log=None) -> Dict[str, float]:
     """Evaluate over an imdb (reference: tester.py::pred_eval).
 
     Builds all_boxes[class][image] = (n, 5) [x1..y2, score] in original
@@ -130,7 +131,14 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
     batch_size > 1 in the loader) amortizes it. 1 = fully serial
     (enqueue, then immediately read); 2 ≈ the previous fixed 1-in-flight
     pipeline.
+
+    event_log: optional graftscope EventLog — the pass then ends with an
+    ``eval`` event carrying the result dict and wall time (obs/report.py
+    folds these into the run summary).
     """
+    import time as _time
+
+    t_start = _time.perf_counter()
     num_classes = imdb.num_classes
     num_images = len(test_loader.roidb)
     all_boxes: List[List] = [
@@ -195,11 +203,16 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
     if out_json:
         kwargs["out_json"] = out_json
     if want_masks and hasattr(imdb, "evaluate_segmentations"):
-        return imdb.evaluate_segmentations(all_boxes, all_masks, **kwargs)
-    if want_masks:
-        logger.warning("%s has no segm evaluation; reporting boxes only",
-                       type(imdb).__name__)
-    return imdb.evaluate_detections(all_boxes, **kwargs)
+        results = imdb.evaluate_segmentations(all_boxes, all_masks, **kwargs)
+    else:
+        if want_masks:
+            logger.warning("%s has no segm evaluation; reporting boxes only",
+                           type(imdb).__name__)
+        results = imdb.evaluate_detections(all_boxes, **kwargs)
+    if event_log is not None and event_log.enabled:
+        event_log.emit("eval", images=num_images, results=results,
+                       wall_s=round(_time.perf_counter() - t_start, 3))
+    return results
 
 
 def _batch_mask_rles(predictor: Predictor, batch, metas, per_image,

@@ -249,7 +249,7 @@ def forward_train(
             fg_fraction=cfg.train.fg_fraction,
             fg_thresh=cfg.train.fg_thresh,
             bg_thresh_hi=cfg.train.bg_thresh_hi,
-            bg_thresh_lo=cfg.train.bg_thresh_lo,
+            bg_thresh_lo=cfg.train.bg_thresh_lo_value,
             bbox_means=cfg.train.bbox_means,
             bbox_stds=cfg.train.bbox_stds,
         ),
@@ -400,7 +400,7 @@ def forward_train_rcnn(
             fg_fraction=cfg.train.fg_fraction,
             fg_thresh=cfg.train.fg_thresh,
             bg_thresh_hi=cfg.train.bg_thresh_hi,
-            bg_thresh_lo=cfg.train.bg_thresh_lo,
+            bg_thresh_lo=cfg.train.bg_thresh_lo_value,
             bbox_means=cfg.train.bbox_means,
             bbox_stds=cfg.train.bbox_stds,
         ),

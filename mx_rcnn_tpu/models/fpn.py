@@ -255,12 +255,23 @@ def pack_placements(shapes: Sequence[Tuple[int, int]], gap: int = 1
 
 def apply_rpn_head_packed(rpn_head, pyramid: Dict[int, jnp.ndarray]):
     """Apply a shared RPN head to all RPN_LEVELS as one packed-canvas
-    call; shared by FPNFasterRCNN and ViTDetector. The gap=1 packing is
-    sufficient for heads whose spatial reach is one 3x3 conv (RPNHead);
-    a head with deeper spatial convs would need gap >= its receptive
-    radius."""
+    call; shared by FPNFasterRCNN and ViTDetector.
+
+    The inter-level gap is the head's declared spatial receptive radius
+    (``SPATIAL_RADIUS`` — 1 for RPNHead's single 3x3 conv): any deeper
+    head must declare its radius, and a head class that doesn't declare
+    one fails loudly here rather than silently leaking activations
+    across adjacent levels on the canvas."""
+    radius = getattr(type(rpn_head), "SPATIAL_RADIUS", None)
+    if radius is None:
+        raise ValueError(
+            f"{type(rpn_head).__name__} declares no SPATIAL_RADIUS: the "
+            "packed-canvas RPN application needs the head's spatial "
+            "receptive radius to size the inter-level gap (declare "
+            "`SPATIAL_RADIUS: ClassVar[int]` on the head, or disable "
+            "network.fpn_packed_rpn_head)")
     tensors = [pyramid[lv] for lv in RPN_LEVELS]
-    canvas, places = pack_levels(tensors)
+    canvas, places = pack_levels(tensors, gap=int(radius))
     cls_c, box_c = rpn_head(canvas)
     out = {}
     for lv, (y, x, h, w) in zip(RPN_LEVELS, places):
@@ -525,7 +536,7 @@ def forward_train(
             fg_fraction=cfg.train.fg_fraction,
             fg_thresh=cfg.train.fg_thresh,
             bg_thresh_hi=cfg.train.bg_thresh_hi,
-            bg_thresh_lo=cfg.train.bg_thresh_lo,
+            bg_thresh_lo=cfg.train.bg_thresh_lo_value,
             bbox_means=cfg.train.bbox_means,
             bbox_stds=cfg.train.bbox_stds,
         ),

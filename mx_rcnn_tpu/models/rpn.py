@@ -12,13 +12,21 @@ groups of 4 for deltas, consistent with ops/anchors.anchor_grid ordering.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, ClassVar, Tuple
 
 import jax.numpy as jnp
 from flax import linen as nn
 
 
 class RPNHead(nn.Module):
+    #: Spatial receptive radius (px on the feature grid) of the head's
+    #: conv stack — one 3x3 conv reaches 1 px; the 1x1 siblings add 0.
+    #: models/fpn.py::apply_rpn_head_packed sizes its inter-level canvas
+    #: gap from this so activations cannot leak across packed levels; a
+    #: deeper head MUST raise it (and gets a loud failure if the
+    #: attribute is missing entirely).
+    SPATIAL_RADIUS: ClassVar[int] = 1
+
     num_anchors: int = 9
     channels: int = 512
     dtype: Any = jnp.bfloat16

@@ -38,6 +38,10 @@ def build_model(cfg: Config, mesh=None):
         raise ValueError(
             f"network.sp_mode must be 'ring' or 'ulysses', got "
             f"{cfg.network.sp_mode!r}")
+    if cfg.network.attn_impl not in ("dense", "streaming"):
+        raise ValueError(
+            f"network.attn_impl must be 'dense' or 'streaming', got "
+            f"{cfg.network.attn_impl!r}")
     # SP is requested by use_ring_attention=True (legacy knob, ring by
     # default) or by naming a non-default sp_mode outright; only the ViT
     # global-attention blocks have a sequence to shard.
@@ -97,6 +101,16 @@ def build_model(cfg: Config, mesh=None):
             sp = (ulysses_attention if cfg.network.sp_mode == "ulysses"
                   else ring_attention)
             attn_fn = partial(sp, mesh=mesh, axis="model")
+            if cfg.network.attn_impl == "streaming":
+                # Mirrors the pp_stages warning below: the knob is
+                # accepted but cannot take effect on this build.
+                from mx_rcnn_tpu.logger import logger
+
+                logger.warning(
+                    "network.attn_impl='streaming' superseded by "
+                    "sequence-parallel attention (sp_mode=%r): the SP "
+                    "kernels manage their own attention internals "
+                    "(numerics unchanged)", cfg.network.sp_mode)
         elif wants_sp:
             # Not an error: SP modes are exact, so a dense build (inference
             # on one chip — no mesh passed) is mathematically identical —

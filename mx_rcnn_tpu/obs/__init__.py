@@ -1,0 +1,70 @@
+"""graftscope — structured runtime telemetry for train/eval/bench.
+
+The reference repo's only runtime signal is the Speedometer log line;
+graftscope adds the machine-readable layer underneath it:
+
+- ``events``:        typed append-only JSONL event stream (EventLog /
+                     NullEventLog; schema = EVENT_TYPES)
+- ``timing``:        StepTimer — per-iteration data-wait / dispatch /
+                     step split, no host syncs added
+- ``compile_track``: every XLA compile becomes a ``compile`` event with
+                     the triggering batch-shape signature
+- ``watchdog``:      StallWatchdog — a hung run emits a ``stall`` event
+                     with stack dumps instead of dying as a bare rc=124
+- ``report``:        ``python -m mx_rcnn_tpu.obs.report`` folds a run's
+                     JSONL into a human summary + BENCH-compatible JSON
+
+Enable on any training entry point with config overrides::
+
+    --set obs.enabled=true --set obs.dir=runs/myrun
+
+When disabled (the default) every surface degrades to a no-op sink and
+the train hot path is unchanged. See the README's graftscope section for
+the event schema.
+"""
+
+from __future__ import annotations
+
+from mx_rcnn_tpu.obs.events import (
+    EVENT_TYPES,
+    EventLog,
+    NullEventLog,
+    event_log_path,
+    open_event_log,
+    run_meta_fields,
+)
+from mx_rcnn_tpu.obs.timing import StepTimer
+from mx_rcnn_tpu.obs.watchdog import StallWatchdog
+
+__all__ = [
+    "EVENT_TYPES",
+    "EventLog",
+    "NullEventLog",
+    "StallWatchdog",
+    "StepTimer",
+    "event_log_path",
+    "obs_from_config",
+    "open_event_log",
+    "run_meta_fields",
+]
+
+
+def obs_from_config(cfg, default_dir: str = ""):
+    """Config → sink: a real EventLog when ``cfg.obs.enabled`` (at
+    ``cfg.obs.dir``, else ``default_dir``), the NullEventLog otherwise.
+    The disabled path touches no filesystem and imports no jax."""
+    if not cfg.obs.enabled:
+        return NullEventLog()
+    directory = cfg.obs.dir or default_dir
+    if not directory:
+        raise ValueError(
+            "obs.enabled=true needs obs.dir (or a caller-provided run "
+            "directory) to place events.jsonl")
+    try:
+        import jax
+
+        process_index = jax.process_index()
+    except (ImportError, RuntimeError):
+        process_index = 0
+    return open_event_log(directory, process_index=process_index,
+                          flush_every=cfg.obs.flush_every)

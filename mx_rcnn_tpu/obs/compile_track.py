@@ -1,0 +1,91 @@
+"""Compile-event accounting via jax.monitoring.
+
+Steady-state recompiles are the silent throughput killer on TPU: a shape
+that drifts (an unpadded tail batch, a new pad bucket, a donation-layout
+mismatch) costs minutes of XLA time that shows up only as a mysteriously
+slow step. jax emits per-compile durations on its monitoring bus
+(``/jax/core/compile/{jaxpr_trace,jaxpr_to_mlir_module,backend_compile}
+_duration``); this module forwards them to the active EventLog as
+``compile`` records, labelled with the shape signature of the batch most
+recently handed to the train loop (StepTimer calls ``note_batch``) — the
+prime recompile suspect.
+
+jax's listener registry is append-only (no unregister), so the listener
+is installed once per process and routed through a module-level active
+sink; ``deactivate()`` just clears the sink. With no active sink the
+listener is a two-comparison no-op.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from mx_rcnn_tpu.obs.events import EventLog
+
+_lock = threading.Lock()
+_active: Optional[EventLog] = None
+_installed = False
+_batch = None  # the most recently dispatched batch (a dict of arrays)
+
+#: monitoring keys forwarded as compile events; the last path segment
+#: (minus "_duration") becomes the record's ``phase`` field. Only
+#: backend_compile is a real XLA compile — report counts those.
+_COMPILE_SUFFIX = "_duration"
+_COMPILE_MARKER = "/compile/"
+
+
+def note_batch(batch) -> None:
+    """Remember the batch about to be dispatched (cheap: one ref store).
+    Read back only if a compile event actually fires."""
+    global _batch
+    _batch = batch
+
+
+def shape_signature() -> Optional[Dict[str, Any]]:
+    """Shapes of the last noted batch, or None before the first step
+    (init/first-trace compiles have no triggering batch)."""
+    batch = _batch
+    if batch is None:
+        return None
+    try:
+        return {k: list(getattr(v, "shape", ())) for k, v in batch.items()}
+    except AttributeError:  # not a mapping — stringify the type instead
+        return {"batch": [repr(type(batch))]}
+
+
+def _on_event_duration(event: str, duration_secs: float, **kwargs) -> None:
+    log = _active
+    if log is None or _COMPILE_MARKER not in event:
+        return
+    phase = event.rsplit("/", 1)[-1]
+    if phase.endswith(_COMPILE_SUFFIX):
+        phase = phase[: -len(_COMPILE_SUFFIX)]
+    log.emit("compile", phase=phase, event=event,
+             duration_ms=round(duration_secs * 1e3, 3),
+             shapes=shape_signature())
+
+
+def activate(log: EventLog) -> bool:
+    """Route compile events to ``log``. Returns False when jax (or its
+    monitoring bus) is unavailable — telemetry degrades, never blocks."""
+    global _active, _installed
+    with _lock:
+        if not _installed:
+            try:
+                import jax.monitoring
+
+                jax.monitoring.register_event_duration_secs_listener(
+                    _on_event_duration)
+            except (ImportError, AttributeError):
+                return False
+            _installed = True
+        _active = log
+    return True
+
+
+def deactivate() -> None:
+    global _active, _batch
+    with _lock:
+        _active = None
+        _batch = None

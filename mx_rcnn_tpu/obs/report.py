@@ -1,0 +1,197 @@
+"""Fold a graftscope JSONL event stream into a run report.
+
+    python -m mx_rcnn_tpu.obs.report RUN_DIR_OR_JSONL [--json OUT.json]
+
+Prints a human summary (phase timing, throughput percentiles, compile
+accounting, data-wait fraction, stalls/crashes) and optionally writes a
+BENCH-compatible JSON blob (top-level metric/value/unit plus the full
+summary as detail) that BENCH_*.json tooling and regression gates can
+consume. stdlib-only — runs anywhere the JSONL can be copied to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Parse one JSONL file (or a run dir holding events.jsonl). A
+    truncated final line — the normal signature of a killed run — is
+    skipped, not fatal."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    events = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail write of a killed run
+    return events
+
+
+def _percentile(sorted_vals: List[float], pct: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(pct / 100.0 * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold an event list into the run summary dict (the --json payload's
+    ``detail``). Keys are stable — BENCH tooling reads them."""
+    by_type: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        by_type.setdefault(e.get("type", "?"), []).append(e)
+
+    run_meta = (by_type.get("run_meta") or [{}])[0]
+    timed = [e for e in by_type.get("step", ()) if "step_ms" in e]
+    speed = [e["samples_per_sec"] for e in by_type.get("step", ())
+             if "samples_per_sec" in e]
+
+    step_ms = sorted(e["step_ms"] for e in timed)
+    total_step_ms = sum(step_ms)
+    data_wait_ms = sorted(e.get("data_wait_ms", 0.0) for e in timed)
+    total_wait_ms = sum(data_wait_ms)
+
+    batch_size = run_meta.get("batch_size")
+    # Throughput: prefer the Speedometer's measured windows (they bracket
+    # the MetricBag drain, i.e. real end-to-end time); else derive from
+    # the per-step median and the run_meta batch size.
+    p50 = _percentile(step_ms, 50)
+    if speed:
+        img_s = _percentile(sorted(speed), 50)
+    elif batch_size and p50 > 0:
+        img_s = batch_size * 1000.0 / p50
+    else:
+        img_s = None
+
+    compiles = [e for e in by_type.get("compile", ())
+                if e.get("phase") == "backend_compile"]
+    # A compile after the first completed step is a steady-state
+    # recompile — the silent throughput killer the tracker exists for.
+    recompiles = [e for e in compiles if e.get("step", 0) >= 1]
+
+    crash = (by_type.get("crash") or [None])[-1]
+    summary: Dict[str, Any] = {
+        "run": {k: run_meta.get(k) for k in
+                ("config_digest", "network", "dataset", "mesh",
+                 "jax_version", "backend", "device_count", "git_sha",
+                 "batch_size", "steps_per_epoch", "prefix", "tool")
+                if k in run_meta},
+        "events": len(events),
+        "steps": len(timed),
+        "epochs": len(by_type.get("epoch", ())),
+        "throughput": {
+            "img_s": round(img_s, 3) if img_s is not None else None,
+            "step_ms_p50": round(p50, 3),
+            "step_ms_p90": round(_percentile(step_ms, 90), 3),
+            "step_ms_max": round(step_ms[-1], 3) if step_ms else 0.0,
+        },
+        "data_wait": {
+            "ms_p50": round(_percentile(data_wait_ms, 50), 3),
+            "fraction": (round(total_wait_ms / total_step_ms, 4)
+                         if total_step_ms else 0.0),
+        },
+        "compile": {
+            "count": len(compiles),
+            "total_ms": round(sum(e.get("duration_ms", 0.0)
+                                  for e in compiles), 3),
+            "steady_state_count": len(recompiles),
+            "steady_state_shapes": [e.get("shapes") for e in recompiles],
+        },
+        "checkpoints": len(by_type.get("checkpoint", ())),
+        "evals": [e.get("results") for e in by_type.get("eval", ())],
+        "bench": {e.get("config", f"cfg{i}"):
+                  {k: v for k, v in e.items()
+                   if k not in ("type", "t_wall", "t_mono", "process",
+                                "step", "config")}
+                  for i, e in enumerate(by_type.get("bench", ()))},
+        "stalls": len(by_type.get("stall", ())),
+        "crash": ({"error": crash.get("error"), "step": crash.get("step")}
+                  if crash else None),
+    }
+    return summary
+
+
+def bench_blob(summary: Dict[str, Any]) -> Dict[str, Any]:
+    """BENCH-compatible wrapper: one headline metric line + full detail."""
+    img_s = summary["throughput"]["img_s"]
+    return {
+        "metric": "graftscope_train_img_per_sec",
+        "value": img_s if img_s is not None else 0.0,
+        "unit": "img/s",
+        "steps": summary["steps"],
+        "compile_count": summary["compile"]["count"],
+        "compile_total_ms": summary["compile"]["total_ms"],
+        "data_wait_fraction": summary["data_wait"]["fraction"],
+        "stall_count": summary["stalls"],
+        "detail": summary,
+    }
+
+
+def render(summary: Dict[str, Any]) -> str:
+    run = summary["run"]
+    tp = summary["throughput"]
+    dw = summary["data_wait"]
+    co = summary["compile"]
+    lines = [
+        "graftscope run report",
+        "  run:        " + ", ".join(
+            f"{k}={v}" for k, v in run.items()) if run else "  run:        -",
+        f"  events:     {summary['events']} "
+        f"({summary['steps']} steps, {summary['epochs']} epochs, "
+        f"{summary['checkpoints']} checkpoints, "
+        f"{len(summary['evals'])} evals)",
+        f"  throughput: {tp['img_s']} img/s | step p50 {tp['step_ms_p50']} "
+        f"ms, p90 {tp['step_ms_p90']} ms, max {tp['step_ms_max']} ms",
+        f"  data wait:  p50 {dw['ms_p50']} ms ({dw['fraction']:.1%} of "
+        "step time)",
+        f"  compiles:   {co['count']} ({co['total_ms']:.0f} ms total), "
+        f"{co['steady_state_count']} in steady state",
+        f"  stalls:     {summary['stalls']}",
+    ]
+    for name, row in summary["bench"].items():
+        lines.append(f"  bench:      {name}: {row}")
+    if summary["crash"]:
+        lines.append(f"  CRASH:      step {summary['crash']['step']}: "
+                     f"{summary['crash']['error']}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mx_rcnn_tpu.obs.report",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run directory (holding events.jsonl) "
+                                 "or a JSONL file")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    metavar="OUT.json",
+                    help="also write the BENCH-compatible JSON blob here")
+    args = ap.parse_args(argv)
+    try:
+        events = load_events(args.path)
+    except OSError as exc:
+        print(f"error: cannot read {args.path}: {exc}", file=sys.stderr)
+        return 2
+    summary = summarize(events)
+    print(render(summary))
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            json.dump(bench_blob(summary), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Per-iteration phase timing for the train loop — no host syncs added.
+
+The split follows the loop's own structure (tools/train.py::fit_detector):
+
+  data_wait_ms  — time blocked in the loader's ``next()`` (host input
+                  pipeline: decode/augment/stack; includes the
+                  multi-step-dispatch group stacking).
+  dispatch_ms   — from batch-in-hand to the train step's RETURN. The step
+                  is an async dispatch, so in steady state this is the
+                  host-side enqueue cost — UNLESS the device queue is
+                  full, in which case dispatch blocks and absorbs device
+                  time (backpressure).
+  step_ms       — the full iteration wall time (data wait + dispatch +
+                  callback/bookkeeping). In steady state the device is
+                  the bottleneck iff step_ms ≈ device step time: device
+                  time is never measured directly because that would
+                  take a per-step host sync, which is exactly the
+                  overhead this repo's lazy-drain discipline
+                  (train/metrics.py::MetricBag) exists to avoid. The
+                  drain still happens — at Speedometer log boundaries —
+                  so windowed step_ms is honest end-to-end time.
+
+When the sink is disabled, ``iterate`` degrades to ``enumerate`` and
+``dispatched()`` to one attribute check: zero events, zero allocations.
+"""
+
+from __future__ import annotations
+
+import time
+
+from mx_rcnn_tpu.obs.events import EventLog
+
+
+class StepTimer:
+    """Times each train iteration and emits one ``step`` event for it.
+
+    Usage (the fit_detector wiring)::
+
+        timer = StepTimer(event_log, watchdog=watchdog)
+        for i, batch in timer.iterate(epoch, batches):
+            state, metrics = step_fn(state, batch, key)
+            timer.dispatched()          # marks the dispatch boundary
+            ...                          # metrics/callbacks
+
+    Also drives the stall watchdog (one ``beat`` per completed iteration,
+    carrying the iteration duration for the trailing-median threshold)
+    and refreshes the compile tracker's shape signature so a recompile
+    event can name the batch shapes that triggered it.
+    """
+
+    def __init__(self, log: EventLog, watchdog=None, track_shapes=True):
+        self.log = log
+        self.watchdog = watchdog
+        self.track_shapes = track_shapes
+        self.total_steps = 0
+        self._t_dispatch = None
+
+    def dispatched(self):
+        """Record the train-step return time (the dispatch boundary)."""
+        if self.log.enabled:
+            self._t_dispatch = time.perf_counter()
+
+    def iterate(self, epoch: int, batches):
+        """Yield ``(i, batch)`` like ``enumerate(batches)``, timing each
+        iteration. Pass-through when the sink is disabled."""
+        if not self.log.enabled:
+            yield from enumerate(batches)
+            return
+        from mx_rcnn_tpu.obs import compile_track
+
+        it = iter(batches)
+        i = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            t1 = time.perf_counter()
+            if self.track_shapes:
+                compile_track.note_batch(batch)
+            self._t_dispatch = None
+            yield i, batch
+            t2 = time.perf_counter()
+            self.total_steps += 1
+            self.log.set_step(self.total_steps)
+            step_s = t2 - t0
+            fields = {
+                "epoch": epoch,
+                "batch": i,
+                "data_wait_ms": round((t1 - t0) * 1e3, 3),
+                "step_ms": round(step_s * 1e3, 3),
+            }
+            if self._t_dispatch is not None:
+                fields["dispatch_ms"] = round(
+                    (self._t_dispatch - t1) * 1e3, 3)
+            self.log.emit("step", **fields)
+            if self.watchdog is not None:
+                self.watchdog.beat(step_s)
+            i += 1

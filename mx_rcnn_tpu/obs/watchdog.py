@@ -1,0 +1,125 @@
+"""Heartbeat/stall watchdog — turns a hung run into a diagnosable artifact.
+
+BENCH_r05 died as rc=124 (external timeout) with no artifact saying which
+phase stalled. This watchdog is the in-process tripwire: the train loop
+beats once per completed iteration (StepTimer); a daemon thread checks
+the heartbeat on a poll interval and, when no step has completed within
+``max(min_stall_s, stall_factor × trailing-median step time)``, emits one
+``stall`` event carrying every thread's current stack — flushed to disk
+immediately, so the evidence survives the SIGKILL that usually follows.
+
+The threshold adapts to the run: before the FIRST completed step the
+floor is ``COLD_GRACE × min_stall_s`` (cold-start XLA compiles cost
+minutes — a healthy first trace must not read as a stall, but a truly
+hung compile still surfaces); once steps flow, the trailing median makes the
+factor meaningful for fast and slow configs alike. One event per stall
+episode — the tripwire re-arms on the next heartbeat.
+"""
+
+from __future__ import annotations
+
+import statistics
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Dict, Optional
+
+from mx_rcnn_tpu.obs.events import EventLog
+
+
+def _stack_dump(skip_ident: Optional[int] = None) -> Dict[str, str]:
+    """Current stacks of all threads (except ``skip_ident``, the watchdog
+    itself), keyed by thread name — the stall event's payload."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        if ident == skip_ident:
+            continue
+        name = names.get(ident, f"thread-{ident}")
+        out[name] = "".join(traceback.format_stack(frame))
+    return out
+
+
+class StallWatchdog:
+    """Daemon thread emitting a ``stall`` event when the heartbeat stops.
+
+    ``beat(duration_s)`` is the only hot-path call: one lock, one deque
+    append. ``check(now)`` is separated from the thread loop so tests can
+    drive the stall logic synchronously.
+    """
+
+    #: pre-first-step threshold multiplier on min_stall_s (see module
+    #: docstring: cold compiles are slow but a hung compile must still
+    #: eventually fire).
+    COLD_GRACE = 10.0
+
+    def __init__(self, log: EventLog, stall_factor: float = 10.0,
+                 min_stall_s: float = 60.0, poll_s: float = 5.0,
+                 window: int = 101):
+        self.log = log
+        self.stall_factor = float(stall_factor)
+        self.min_stall_s = float(min_stall_s)
+        self.poll_s = float(poll_s)
+        self._durations = deque(maxlen=window)
+        self._last_beat = time.monotonic()
+        self._fired = False
+        self._stalls = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="graftscope-watchdog", daemon=True)
+
+    def start(self):
+        with self._lock:
+            self._last_beat = time.monotonic()
+        self._thread.start()
+
+    def beat(self, duration_s: Optional[float] = None):
+        """One completed step: refresh the heartbeat, extend the trailing
+        window, re-arm the tripwire."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+            if duration_s is not None:
+                self._durations.append(float(duration_s))
+            self._fired = False
+
+    def threshold_s(self) -> float:
+        with self._lock:
+            if not self._durations:
+                return self.COLD_GRACE * self.min_stall_s
+            median = statistics.median(self._durations)
+        return max(self.min_stall_s, self.stall_factor * median)
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """Evaluate the stall condition once; emit at most one event per
+        episode. Returns True when a stall event was emitted."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            waited = now - self._last_beat
+            fired = self._fired
+            median = (statistics.median(self._durations)
+                      if self._durations else None)
+        threshold = self.threshold_s()
+        if fired or waited <= threshold:
+            return False
+        with self._lock:
+            self._fired = True
+            self._stalls += 1
+        self.log.emit(
+            "stall",
+            waited_s=round(waited, 3),
+            threshold_s=round(threshold, 3),
+            median_step_s=round(median, 4) if median is not None else None,
+            stacks=_stack_dump(skip_ident=self._thread.ident))
+        return True
+
+    def _run(self):
+        while not self._stop.wait(self.poll_s):
+            self.check()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=self.poll_s + 1.0)

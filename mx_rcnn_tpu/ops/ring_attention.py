@@ -38,6 +38,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover - version-dependent import
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -66,10 +71,14 @@ def _block_attn_update(carry, kv, q, scale, key_mask=None):
 
 def _mark_varying(x, axes):
     """Mark x as varying over the given mesh axes (shard_map manual-axes
-    type tracking). pvary is deprecated in favor of pcast in jax >= 0.9."""
+    type tracking). pvary is deprecated in favor of pcast in jax >= 0.9;
+    jax lines OLD enough to predate varying types (< 0.5, no pvary at
+    all) need no marking — their shard_map mixes the values freely."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axes, to="varying")
-    return lax.pvary(x, axes)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x
 
 
 def _streaming_init(q, vary_axes=()):
@@ -155,7 +164,7 @@ def _sp_entry(make_sharded_fn, q, k, v, mesh: Mesh, axis: str):
     make_sharded_fn(vary) -> the per-shard callable; the layout (spec and
     varying axes) is computed ONCE here so the two can't diverge."""
     spec, vary = _sp_layout(q, mesh, axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         make_sharded_fn(vary),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -32,12 +32,20 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover - version-dependent import
+    from jax.experimental.shard_map import shard_map
+
 
 def _mark_varying(x, axes):
-    """shard_map manual-axes type tracking (see ops/ring_attention.py)."""
+    """shard_map manual-axes type tracking (see ops/ring_attention.py);
+    identity on jax lines without varying types (< 0.5)."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axes, to="varying")
-    return lax.pvary(x, axes)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x
 
 
 def pipeline_apply(
@@ -115,7 +123,7 @@ def pipeline_apply(
 
     param_specs = jax.tree.map(lambda _: P(axis), stage_params)
     xs_spec = P(None, b_ax) if b_ax else P()
-    out = jax.shard_map(
+    out = shard_map(
         pipelined, mesh=mesh,
         in_specs=(param_specs, xs_spec), out_specs=xs_spec,
     )(stage_params, xs)

@@ -56,7 +56,7 @@ def synthetic_batch(cfg, batch_images=None):
     }
 
 
-def _timeit(name, fn, *args, iters=5):
+def _timeit(name, fn, *args, iters=5, elog=None):
     out = jax.block_until_ready(fn(*args))
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -64,6 +64,8 @@ def _timeit(name, fn, *args, iters=5):
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters * 1000
     print(f"{name:36s} {dt:9.2f} ms")
+    if elog is not None and elog.enabled:
+        elog.emit("step", label=name, step_ms=round(dt, 3), iters=iters)
     return dt
 
 
@@ -81,12 +83,25 @@ def main(argv=None):
     ap.add_argument("--stages", action="store_true",
                     help="also time the additive stage prefixes (several "
                          "extra compiles)")
+    ap.add_argument("--obs-dir", dest="obs_dir", default=None,
+                    help="also write a graftscope event stream (one `step` "
+                         "event per timed row + every compile) here; fold "
+                         "with `python -m mx_rcnn_tpu.obs.report`")
     args = ap.parse_args(argv)
 
     cfg = generate_config(
         args.network, args.dataset,
         **{"image.pad_shape": tuple(args.pad),
            "train.batch_images": args.batch_images})
+    elog = None
+    if args.obs_dir:
+        from mx_rcnn_tpu.obs import compile_track, open_event_log, \
+            run_meta_fields
+
+        elog = open_event_log(args.obs_dir, fresh=True)  # per-run artifact
+        elog.emit("run_meta", **run_meta_fields(
+            cfg, tool="profile", batch_size=args.batch_images))
+        compile_track.activate(elog)
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0))
     batch = synthetic_batch(cfg)
@@ -98,21 +113,21 @@ def main(argv=None):
                                        method=FasterRCNN.extract)
                            .astype(jnp.float32))
         _timeit("backbone fwd", jax.jit(backbone), params, batch,
-                iters=args.iters)
+                iters=args.iters, elog=elog)
 
         def with_rpn(p, bt):
             _, cl, bx, _ = F._backbone_rpn(model, p, bt["image"], cfg)
             return jnp.sum(cl.astype(jnp.float32)), jnp.sum(
                 bx.astype(jnp.float32))
         _timeit("+rpn heads", jax.jit(with_rpn), params, batch,
-                iters=args.iters)
+                iters=args.iters, elog=elog)
 
         def with_targets(p, bt, r):
             _, cl, bx, anch = F._backbone_rpn(model, p, bt["image"], cfg)
             t = F._assign_anchors_batch(anch, bt, r, cfg)
             return jnp.sum(t.labels), jnp.sum(cl.astype(jnp.float32))
         _timeit("+anchor targets", jax.jit(with_targets), params, batch, rng,
-                iters=args.iters)
+                iters=args.iters, elog=elog)
 
         def with_proposals(p, bt, r):
             _, cl, bx, anch = F._backbone_rpn(model, p, bt["image"], cfg)
@@ -126,13 +141,13 @@ def main(argv=None):
                 topk_impl=cfg.network.proposal_topk)
             return jnp.sum(rois), jnp.sum(rv)
         _timeit("+proposals (topk+nms)", jax.jit(with_proposals), params,
-                batch, rng, iters=args.iters)
+                batch, rng, iters=args.iters, elog=elog)
 
         def full_fwd(p, bt, r):
             loss, _ = F.forward_train(model, p, bt, r, cfg)
             return loss
         _timeit("full fwd (loss)", jax.jit(full_fwd), params, batch, rng,
-                iters=args.iters)
+                iters=args.iters, elog=elog)
 
     # The honest end-to-end number: full train step, donated state, scalar
     # metric outputs only (same quantity bench.py reports).
@@ -159,6 +174,10 @@ def main(argv=None):
     b = cfg.train.batch_images
     print(f"{'train step (donated)':36s} {dt:9.2f} ms   "
           f"{b / dt * 1000:6.2f} img/s/chip")
+    if elog is not None:
+        elog.emit("step", label="train step (donated)",
+                  step_ms=round(dt, 3), iters=args.iters,
+                  samples_per_sec=round(b / dt * 1000, 3))
 
     if args.trace_dir:
         with jax.profiler.trace(args.trace_dir):
@@ -167,6 +186,14 @@ def main(argv=None):
                 state, metrics = run_step(state, batch, k)
             jax.block_until_ready(metrics["TotalLoss"])
         print(f"trace written to {args.trace_dir}")
+
+    if elog is not None:
+        from mx_rcnn_tpu.obs import compile_track
+
+        compile_track.deactivate()
+        elog.close()
+        print(f"graftscope events written to {elog.path} "
+              "(fold with `python -m mx_rcnn_tpu.obs.report`)")
 
 
 if __name__ == "__main__":

@@ -99,6 +99,23 @@ def _attach_proposals(cfg: Config, rpn_file: str) -> List[Dict]:
     return out
 
 
+def apply_fast_rcnn_bg_preset(cfg: Config) -> Config:
+    """Fast-RCNN parity: the reference samples bg rois from IoU in
+    [0.1, 0.5) on this path (vs [0.0, 0.5) end2end). The preset applies
+    only when ``train.bg_thresh_lo`` is still the None sentinel; an
+    explicit override — INCLUDING 0.0, which the sentinel makes
+    expressible — is respected, and either way the decision is logged."""
+    from dataclasses import replace
+
+    if cfg.train.bg_thresh_lo is None:
+        logger.info("train.bg_thresh_lo unset: applying the Fast-RCNN "
+                    "preset 0.1 (reference rcnn/io/rcnn.py bg sampling)")
+        return cfg.with_updates(train=replace(cfg.train, bg_thresh_lo=0.1))
+    logger.info("explicit train.bg_thresh_lo=%g kept on the Fast-RCNN path",
+                cfg.train.bg_thresh_lo)
+    return cfg
+
+
 def train_rcnn(cfg: Config, prefix: str, rpn_file: str,
                pretrained_params=None, end_epoch: Optional[int] = None,
                frozen_trunk: bool = False, mesh_spec: str = "",
@@ -106,16 +123,9 @@ def train_rcnn(cfg: Config, prefix: str, rpn_file: str,
     """Fast-R-CNN fit over precomputed proposals (reference:
     tools/train_rcnn.py over ROIIter, incl. its add_bbox_regression_targets
     call when bbox normalization is not precomputed)."""
-    from dataclasses import replace
-
     from mx_rcnn_tpu.targets.bbox_stats import resolve_bbox_stats
 
-    # Fast-RCNN parity: the reference samples bg rois from IoU in [0.1, 0.5)
-    # on this path (vs [0.0, 0.5) end2end). Apply the preset here so the
-    # alternate pipeline matches without a CLI flag; an explicit non-default
-    # bg_thresh_lo override is respected.
-    if cfg.train.bg_thresh_lo == 0.0:
-        cfg = cfg.with_updates(train=replace(cfg.train, bg_thresh_lo=0.1))
+    cfg = apply_fast_rcnn_bg_preset(cfg)
 
     roidb = _attach_proposals(cfg, rpn_file)
     cfg = resolve_bbox_stats(cfg, roidb)

@@ -19,6 +19,8 @@ from mx_rcnn_tpu.data.datasets.imdb import filter_roidb, merge_roidb
 from mx_rcnn_tpu.data.loader import AnchorLoader
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models.zoo import build_model, forward_train, init_params
+from mx_rcnn_tpu.obs import StallWatchdog, StepTimer, obs_from_config, run_meta_fields
+from mx_rcnn_tpu.obs import compile_track
 from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
 from mx_rcnn_tpu.train.callback import Speedometer
 from mx_rcnn_tpu.train.checkpoint import (
@@ -210,7 +212,27 @@ def fit_detector(
             "multi_step_dispatch=%d drops %d trailing batch(es) per epoch "
             "(loader yields %d)", multi, len(loader) % multi, len(loader))
     batch_size = cfg.train.batch_images * accum * n_data * multi
-    speedometer = Speedometer(batch_size, frequent)
+
+    # graftscope telemetry (mx_rcnn_tpu/obs): a no-op sink unless
+    # cfg.obs.enabled — the disabled path adds nothing to the hot loop.
+    obs_log = obs_from_config(cfg, default_dir=f"{prefix}.obs")
+    watchdog = None
+    if obs_log.enabled:
+        obs_log.emit("run_meta", **run_meta_fields(
+            cfg, mesh=mesh, prefix=prefix, batch_size=batch_size,
+            steps_per_epoch=steps_per_epoch, begin_epoch=begin_epoch,
+            end_epoch=end_epoch, grad_accum=accum,
+            multi_step_dispatch=multi))
+        if cfg.obs.track_compiles:
+            compile_track.activate(obs_log)
+        if cfg.obs.watchdog:
+            watchdog = StallWatchdog(
+                obs_log, stall_factor=cfg.obs.stall_factor,
+                min_stall_s=cfg.obs.stall_min_s,
+                poll_s=cfg.obs.watchdog_poll_s)
+            watchdog.start()
+    timer = StepTimer(obs_log, watchdog=watchdog)
+    speedometer = Speedometer(batch_size, frequent, event_log=obs_log)
 
     # Async epoch-end saves (train/checkpoint.py CheckpointWriter); the
     # multi-host primary-only pattern needs the synchronous path (orbax's
@@ -224,13 +246,19 @@ def fit_detector(
     try:
         for epoch in range(begin_epoch, end_epoch):
             bag = MetricBag()
-            for i, batch in enumerate(_dispatch_batches(loader, multi)):
+            for i, batch in timer.iterate(
+                    epoch, _dispatch_batches(loader, multi)):
                 rng, k = jax.random.split(rng)
                 state, metrics = step_fn(
                     state, shard_batch(batch, mesh, stacked=multi > 1), k)
+                timer.dispatched()
                 bag.update(metrics)
                 speedometer(epoch, i, bag)
             logger.info("Epoch[%d] done. %s", epoch, bag.format())
+            if obs_log.enabled:
+                # bag.format() above already drained the pending device
+                # scalars — this get() re-reads host-side sums only.
+                obs_log.emit("epoch", epoch=epoch, metrics=bag.get())
             # checkpoint_period > 1 (long small-epoch runs, e.g. the DETR
             # gate's 150 epochs): save every Nth epoch and always the last —
             # resume granularity traded against orbax save time.
@@ -240,9 +268,25 @@ def fit_detector(
                 save(prefix, epoch + 1, state.params, state.opt_state,
                      means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
                      num_classes=cfg.dataset.num_classes)
+                if obs_log.enabled:
+                    obs_log.emit("checkpoint", epoch=epoch + 1,
+                                 prefix=prefix,
+                                 durable=writer is None)
             if epoch_callback:
                 epoch_callback(epoch, state, bag)
+    except BaseException as exc:  # graftlint: disable=broad-except — crash telemetry, re-raised below
+        if obs_log.enabled:
+            import traceback
+
+            obs_log.emit("crash", error=repr(exc),
+                         traceback=traceback.format_exc())
+        raise
     finally:
+        if watchdog is not None:
+            watchdog.stop()
+        if obs_log.enabled and cfg.obs.track_compiles:
+            compile_track.deactivate()
+        obs_log.close()
         if writer is not None:
             writer.close()  # the last save must be durable before return
     return jax.device_get(state.params)

@@ -14,9 +14,16 @@ from mx_rcnn_tpu.train.metrics import MetricBag
 
 
 class Speedometer:
-    def __init__(self, batch_size: int, frequent: int = 20):
+    """Logs the reference-format throughput line and, when a graftscope
+    event log is attached, also emits each window as a ``step`` event
+    carrying ``samples_per_sec`` (obs/report.py prefers these measured
+    windows: they bracket the MetricBag drain, so they are honest
+    end-to-end throughput)."""
+
+    def __init__(self, batch_size: int, frequent: int = 20, event_log=None):
         self.batch_size = batch_size
         self.frequent = frequent
+        self.event_log = event_log
         self._tic = time.time()
         self._count = 0
 
@@ -28,6 +35,10 @@ class Speedometer:
                 "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s",
                 epoch, batch, speed, metrics.format(),
             )
+            if self.event_log is not None and self.event_log.enabled:
+                self.event_log.emit("step", epoch=epoch, batch=batch,
+                                    samples_per_sec=round(speed, 3),
+                                    window=self.frequent)
             self._tic = time.time()
             return speed
         return None

@@ -69,8 +69,19 @@ def main():
         num_classes=cfg.dataset.num_classes)
     predictor = Predictor(model, params, cfg)
     loader = TestLoader(roidb, cfg, batch_size=args.batch_size)
+    # graftscope (--set obs.enabled=true [--set obs.dir=...]): the eval
+    # run gets a run_meta record and pred_eval emits the `eval` result.
+    from mx_rcnn_tpu.obs import obs_from_config, run_meta_fields
+
+    obs_log = obs_from_config(cfg, default_dir=f"{args.prefix}.obs")
+    if obs_log.enabled:
+        obs_log.emit("run_meta", **run_meta_fields(
+            cfg, tool="test", prefix=args.prefix, epoch=args.epoch,
+            image_set=image_set))
     results = pred_eval(predictor, loader, ds, vis=args.vis,
-                        thresh=args.thresh, out_json=args.out_json)
+                        thresh=args.thresh, out_json=args.out_json,
+                        event_log=obs_log)
+    obs_log.close()
     logger.info("evaluation: %s", results)
 
 

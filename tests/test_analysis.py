@@ -655,3 +655,39 @@ def test_pallas_kernel_via_partial_is_traced():
                                   out_shape=None)(x)
     """)
     assert "host-sync-in-jit" in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# obs-event-schema
+# ---------------------------------------------------------------------------
+
+def test_obs_schema_flags_unknown_type():
+    findings = lint("""
+        def run(obs_log):
+            obs_log.emit("stepp", step_ms=1.0)
+    """)
+    assert "obs-event-schema" in rules_of(findings)
+    msg = next(f for f in findings if f.rule == "obs-event-schema").message
+    assert "unknown event type 'stepp'" in msg
+
+
+def test_obs_schema_flags_non_literal_type_key():
+    findings = lint("""
+        def run(obs, kind):
+            obs.emit(kind, step_ms=1.0)
+            obs.emit()
+    """)
+    assert sum(f.rule == "obs-event-schema" for f in findings) == 2
+
+
+def test_obs_schema_near_miss_known_literals_and_foreign_emit():
+    findings = lint("""
+        def run(event_log, handler, record, signal):
+            event_log.emit("step", step_ms=1.0)
+            event_log.emit("run_meta", config_digest="abc")
+            self_obs = event_log
+            self_obs.emit("stall", waited_s=2.0)
+            handler.emit(record)      # logging.Handler — out of scope
+            signal.emit("anything")   # Qt-style signal — out of scope
+    """)
+    assert "obs-event-schema" not in rules_of(findings)

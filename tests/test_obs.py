@@ -1,0 +1,442 @@
+"""graftscope (mx_rcnn_tpu/obs) gates.
+
+Unit layer: JSONL schema round-trip, StepTimer phase splits on a fake
+loader, watchdog stall detection, report aggregation over a synthetic
+event log, and the disabled sink's zero-event / zero-drain contract.
+
+Integration layer (tier-1, compile_heavy): a short synthetic
+``fit_detector`` run with obs enabled must produce a foldable event
+stream — run_meta, per-step timing, epoch, checkpoint — and
+``python -m mx_rcnn_tpu.obs.report`` must fold it into throughput +
+compile-count fields; with obs disabled no file is written and the
+MetricBag lazy-drain discipline is untouched.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.obs import (
+    EVENT_TYPES,
+    EventLog,
+    NullEventLog,
+    StallWatchdog,
+    StepTimer,
+    compile_track,
+    event_log_path,
+    obs_from_config,
+    open_event_log,
+    run_meta_fields,
+)
+from mx_rcnn_tpu.obs import report
+from mx_rcnn_tpu.train.callback import Speedometer
+from mx_rcnn_tpu.train.metrics import MetricBag
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# EventLog
+# ---------------------------------------------------------------------------
+
+def test_event_log_schema_roundtrip(tmp_path):
+    """One record of every type survives the JSONL round trip with the
+    common stamps (wall/monotonic time, process, step) and its payload —
+    including numpy scalars/arrays, which must land as plain JSON."""
+    log = open_event_log(str(tmp_path), process_index=0)
+    for i, t in enumerate(EVENT_TYPES):
+        log.set_step(i)
+        log.emit(t, payload=i, np_scalar=np.float32(1.5),  # graftlint: disable=obs-event-schema — iterating the schema itself
+                 np_arr=np.arange(3))
+    log.close()
+    events = report.load_events(str(tmp_path))
+    assert [e["type"] for e in events] == list(EVENT_TYPES)
+    for i, e in enumerate(events):
+        assert e["step"] == i and e["process"] == 0
+        assert e["t_wall"] > 0 and e["t_mono"] > 0
+        assert e["payload"] == i
+        assert e["np_scalar"] == 1.5
+        assert e["np_arr"] == [0, 1, 2]
+
+
+def test_event_log_rejects_unknown_type(tmp_path):
+    sink = EventLog(str(tmp_path / "e.jsonl"))
+    with pytest.raises(ValueError, match="unknown event type"):
+        sink.emit("not_a_type")
+    sink.close()
+
+
+def test_event_log_buffers_steps_flushes_critical(tmp_path):
+    """step records buffer up to flush_every; stall/crash-class records
+    hit disk immediately (they must survive the hang they diagnose)."""
+    path = str(tmp_path / "e.jsonl")
+    log = EventLog(path, flush_every=64)
+
+    def lines():
+        with open(path) as fh:
+            return sum(1 for _ in fh)
+
+    log.emit("step", step_ms=1.0)
+    log.emit("step", step_ms=1.0)
+    assert lines() == 0  # still buffered
+    log.emit("stall", waited_s=9.0)
+    assert lines() == 3  # critical record flushed the buffer with it
+    log.close()
+    assert lines() == 3
+
+
+def test_event_log_path_per_process(tmp_path):
+    assert event_log_path(str(tmp_path)).endswith("events.jsonl")
+    assert event_log_path(str(tmp_path), 3).endswith("events.3.jsonl")
+
+
+def test_run_meta_fields_digest_and_versions():
+    cfg = generate_config("resnet50", "synthetic")
+    fields = run_meta_fields(cfg, tool="test")
+    assert len(fields["config_digest"]) == 16
+    assert fields["network"] == "resnet50" and fields["tool"] == "test"
+    assert "jax_version" in fields
+    # digest tracks the config
+    cfg2 = generate_config("resnet50", "synthetic",
+                           **{"train.lr": 0.5})
+    assert run_meta_fields(cfg2)["config_digest"] != fields["config_digest"]
+
+
+def test_null_sink_is_inert(tmp_path):
+    """The disabled sink touches nothing: no files, no state, and
+    obs_from_config returns it without reading obs.dir."""
+    n = NullEventLog()
+    n.emit("step", step_ms=1.0)
+    n.set_step(5)
+    n.flush()
+    n.close()
+    assert n.step == 0 and n.path is None
+    cfg = generate_config("resnet50", "synthetic",
+                          **{"obs.dir": str(tmp_path / "never")})
+    sink = obs_from_config(cfg)
+    assert isinstance(sink, NullEventLog)
+    assert not (tmp_path / "never").exists()
+    assert os.listdir(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# StepTimer
+# ---------------------------------------------------------------------------
+
+def _slow_loader(n, wait_s):
+    for i in range(n):
+        time.sleep(wait_s)
+        yield {"image": np.zeros((1, 4, 4, 3), np.float32), "i": i}
+
+
+def test_step_timer_phase_split(tmp_path):
+    """Each iteration over a fake loader emits a step event whose
+    data_wait_ms reflects the loader's sleep, with dispatch_ms marked at
+    the dispatched() call and step_ms covering the whole iteration."""
+    log = open_event_log(str(tmp_path))
+    timer = StepTimer(log)
+    seen = []
+    for i, batch in timer.iterate(0, _slow_loader(3, wait_s=0.02)):
+        seen.append((i, batch["i"]))
+        time.sleep(0.01)
+        timer.dispatched()
+    log.close()
+    assert seen == [(0, 0), (1, 1), (2, 2)]
+    steps = [e for e in report.load_events(str(tmp_path))
+             if e["type"] == "step"]
+    assert len(steps) == 3
+    for n, e in enumerate(steps):
+        assert e["step"] == n + 1  # global counter advanced per iteration
+        assert e["epoch"] == 0 and e["batch"] == n
+        assert e["data_wait_ms"] >= 15.0  # the 20 ms loader sleep
+        assert e["dispatch_ms"] >= 8.0  # the 10 ms "dispatch"
+        assert e["step_ms"] >= e["data_wait_ms"] + e["dispatch_ms"] - 1.0
+    assert timer.total_steps == 3
+
+
+def test_step_timer_disabled_is_passthrough_and_lazy():
+    """With the null sink, iterate degrades to enumerate (same objects,
+    zero events) and never drains a MetricBag — the lazy-drain
+    discipline (train/metrics.py) is untouched, i.e. no per-step host
+    sync is added by instrumentation."""
+    timer = StepTimer(NullEventLog())
+    batches = [{"x": 1}, {"x": 2}]
+    bag = MetricBag()
+    out = []
+    for i, batch in timer.iterate(0, batches):
+        bag.update({"TotalLoss": 1.0})
+        timer.dispatched()
+        out.append((i, batch))
+    assert out == [(0, batches[0]), (1, batches[1])]
+    assert out[0][1] is batches[0]  # identity: no copies, no wrapping
+    assert len(bag._pending) == 2  # nothing forced a drain
+    assert timer.total_steps == 0
+
+
+# ---------------------------------------------------------------------------
+# Speedometer emission
+# ---------------------------------------------------------------------------
+
+def test_speedometer_logs_and_emits(tmp_path):
+    log = open_event_log(str(tmp_path))
+    meter = Speedometer(batch_size=2, frequent=2, event_log=log)
+    bag = MetricBag()
+    bag.update({"TotalLoss": 1.0})
+    assert meter(0, 0, bag) is None
+    speed = meter(0, 1, bag)
+    assert speed is not None and speed > 0
+    log.close()
+    windows = [e for e in report.load_events(str(tmp_path))
+               if e["type"] == "step" and "samples_per_sec" in e]
+    assert len(windows) == 1
+    assert windows[0]["window"] == 2
+    assert windows[0]["samples_per_sec"] == pytest.approx(speed, rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# StallWatchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_on_stall_with_stacks(tmp_path):
+    """An artificially stalled step trips the watchdog exactly once per
+    episode, and the stall event carries this (main) thread's stack."""
+    log = open_event_log(str(tmp_path))
+    wd = StallWatchdog(log, stall_factor=2.0, min_stall_s=0.05, poll_s=10)
+    for _ in range(5):
+        wd.beat(0.01)
+    assert wd.threshold_s() == pytest.approx(0.05)  # min_stall_s floor
+    now = time.monotonic()
+    assert not wd.check(now)  # fresh heartbeat: no stall
+    assert wd.check(now + 1.0)  # stalled
+    assert not wd.check(now + 2.0)  # one event per episode
+    wd.beat(0.01)  # heartbeat re-arms the tripwire
+    assert wd.check(time.monotonic() + 1.0)
+    log.close()
+    stalls = [e for e in report.load_events(str(tmp_path))
+              if e["type"] == "stall"]
+    assert len(stalls) == 2
+    assert stalls[0]["waited_s"] >= 0.9
+    assert stalls[0]["median_step_s"] == pytest.approx(0.01)
+    assert any("test_obs" in stack or "MainThread" in name
+               for name, stack in stalls[0]["stacks"].items())
+
+
+def test_watchdog_threshold_scales_with_median():
+    wd = StallWatchdog(NullEventLog(), stall_factor=10.0, min_stall_s=1.0)
+    # pre-first-step: cold-start grace (compiles are slow, not stalls)
+    assert wd.threshold_s() == pytest.approx(
+        StallWatchdog.COLD_GRACE * 1.0)
+    for d in (0.2, 0.3, 0.4):
+        wd.beat(d)
+    assert wd.threshold_s() == pytest.approx(3.0)  # 10 x median(0.3)
+
+
+def test_watchdog_thread_emits(tmp_path):
+    """The real daemon thread path: a stalled 'run' produces a stall
+    event on disk without any synchronous check() calls."""
+    log = open_event_log(str(tmp_path))
+    wd = StallWatchdog(log, stall_factor=2.0, min_stall_s=0.05,
+                       poll_s=0.02)
+    wd.beat(0.01)  # one completed step arms the steady-state threshold
+    wd.start()
+    try:
+        time.sleep(0.3)  # no further beats: stalled from here on
+    finally:
+        wd.stop()
+    log.close()
+    assert any(e["type"] == "stall"
+               for e in report.load_events(str(tmp_path)))
+
+
+# ---------------------------------------------------------------------------
+# Compile tracking
+# ---------------------------------------------------------------------------
+
+def test_compile_tracker_emits_with_shape_signature(tmp_path):
+    import jax
+
+    log = open_event_log(str(tmp_path))
+    assert compile_track.activate(log)
+    try:
+        compile_track.note_batch(
+            {"image": np.zeros((1, 6, 11, 3), np.float32)})
+        jax.jit(lambda x: x * 2.5 + 1.25)(np.ones((2, 3), np.float32))
+    finally:
+        compile_track.deactivate()
+    log.close()
+    compiles = [e for e in report.load_events(str(tmp_path))
+                if e["type"] == "compile"]
+    backend = [e for e in compiles if e["phase"] == "backend_compile"]
+    assert backend, compiles  # tiny kernels are below the persistent-
+    # cache threshold, so the jit above really XLA-compiles every run
+    assert backend[0]["duration_ms"] > 0
+    assert backend[0]["shapes"] == {"image": [1, 6, 11, 3]}
+
+
+# ---------------------------------------------------------------------------
+# report folding
+# ---------------------------------------------------------------------------
+
+def _synthetic_events():
+    mk = lambda t, **kw: dict(  # noqa: E731 — local record factory
+        {"type": t, "t_wall": 0.0, "t_mono": 0.0, "process": 0, "step": 0},
+        **kw)
+    return [
+        mk("run_meta", config_digest="abc", network="resnet50",
+           batch_size=2, steps_per_epoch=4),
+        mk("compile", phase="backend_compile", duration_ms=500.0,
+           shapes=None),
+        mk("step", step=1, epoch=0, batch=0, data_wait_ms=5.0,
+           step_ms=20.0),
+        mk("step", step=2, epoch=0, batch=1, data_wait_ms=1.0,
+           step_ms=10.0),
+        mk("step", step=2, epoch=0, batch=1, samples_per_sec=150.0,
+           window=2),
+        mk("compile", phase="backend_compile", duration_ms=300.0, step=2,
+           shapes={"image": [1, 8, 8, 3]}),
+        mk("compile", phase="jaxpr_trace", duration_ms=10.0, step=2),
+        mk("step", step=3, epoch=0, batch=2, data_wait_ms=2.0,
+           step_ms=10.0),
+        mk("step", step=4, epoch=0, batch=3, data_wait_ms=2.0,
+           step_ms=40.0),
+        mk("epoch", epoch=0, metrics={"TotalLoss": 1.0}),
+        mk("checkpoint", epoch=1, prefix="p"),
+        mk("eval", images=8, results={"mAP": 0.5}),
+        mk("stall", waited_s=9.0),
+        mk("crash", step=4, error="RuntimeError('boom')"),
+    ]
+
+
+def test_report_aggregates_synthetic_log():
+    s = report.summarize(_synthetic_events())
+    assert s["steps"] == 4 and s["epochs"] == 1 and s["checkpoints"] == 1
+    # measured Speedometer window preferred over derived throughput
+    assert s["throughput"]["img_s"] == 150.0
+    assert s["throughput"]["step_ms_p50"] == 20.0
+    assert s["throughput"]["step_ms_max"] == 40.0
+    assert s["data_wait"]["fraction"] == pytest.approx(10.0 / 80.0)
+    # only backend_compile counts as a compile; the one at step>=1 is a
+    # steady-state recompile and surfaces its shape signature
+    assert s["compile"]["count"] == 2
+    assert s["compile"]["total_ms"] == 800.0
+    assert s["compile"]["steady_state_count"] == 1
+    assert s["compile"]["steady_state_shapes"] == [{"image": [1, 8, 8, 3]}]
+    assert s["evals"] == [{"mAP": 0.5}]
+    assert s["stalls"] == 1
+    assert s["crash"]["step"] == 4
+    blob = report.bench_blob(s)
+    assert blob["value"] == 150.0 and blob["compile_count"] == 2
+    assert blob["stall_count"] == 1
+    assert blob["data_wait_fraction"] == pytest.approx(0.125)
+    # derived-throughput fallback when no Speedometer window exists
+    s2 = report.summarize([e for e in _synthetic_events()
+                           if "samples_per_sec" not in e])
+    assert s2["throughput"]["img_s"] == pytest.approx(2 * 1000.0 / 20.0)
+
+
+def test_report_cli_roundtrip(tmp_path):
+    log = open_event_log(str(tmp_path / "run"))
+    log.emit("run_meta", batch_size=1)
+    log.emit("step", step_ms=10.0, data_wait_ms=1.0)
+    log.close()
+    out = tmp_path / "blob.json"
+    assert report.main([str(tmp_path / "run"), "--json", str(out)]) == 0
+    blob = json.loads(out.read_text())
+    assert blob["steps"] == 1 and "compile_count" in blob
+    # truncated tail line (killed run) is skipped, not fatal
+    with open(log.path, "a") as fh:
+        fh.write('{"type": "st')
+    assert len(report.load_events(str(tmp_path / "run"))) == 2
+
+
+# ---------------------------------------------------------------------------
+# fit_detector integration (tier-1 acceptance gate)
+# ---------------------------------------------------------------------------
+
+OBS_TINY = {
+    "image.pad_shape": (128, 128),
+    "image.scales": ((128, 128),),
+    "network.norm": "group",
+    "network.freeze_at": 0,
+    "network.anchor_scales": (2, 4, 8),
+    "train.rpn_pre_nms_top_n": 256,
+    "train.rpn_post_nms_top_n": 64,
+    "train.batch_rois": 32,
+    "train.max_gt_boxes": 8,
+    "train.batch_images": 1,
+    "train.flip": False,
+}
+
+
+def _tiny_fit(tmp_path, prefix_name, **obs_overrides):
+    from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.tools.train import fit_detector
+
+    cfg = generate_config("resnet50", "synthetic",
+                          **{**OBS_TINY, **obs_overrides})
+    ds = SyntheticDataset("train", num_images=4, image_size=128,
+                          max_objects=2, min_size_frac=4, max_size_frac=2)
+    return fit_detector(cfg, ds.gt_roidb(),
+                        prefix=str(tmp_path / prefix_name),
+                        end_epoch=1, frequent=2)
+
+
+@pytest.mark.compile_heavy
+def test_fit_detector_obs_enabled_and_report(tmp_path):
+    """The acceptance gate: a short synthetic fit with obs enabled writes
+    a run_meta + per-step + epoch event stream, and the report CLI folds
+    it into throughput and compile-count fields."""
+    obs_dir = tmp_path / "obsrun"
+    params = _tiny_fit(tmp_path, "ckpt",
+                       **{"obs.enabled": True, "obs.dir": str(obs_dir)})
+    assert params is not None
+    events = report.load_events(str(obs_dir))
+    types = {e["type"] for e in events}
+    assert {"run_meta", "step", "epoch", "checkpoint"} <= types
+
+    meta = next(e for e in events if e["type"] == "run_meta")
+    assert meta["batch_size"] == 1 and meta["steps_per_epoch"] == 4
+    assert meta["mesh"] == {"data": 1, "model": 1}
+    assert len(meta["config_digest"]) == 16
+
+    timed = [e for e in events if e["type"] == "step" and "step_ms" in e]
+    assert len(timed) == 4
+    for e in timed:
+        assert e["data_wait_ms"] >= 0 and e["step_ms"] > 0
+        assert "dispatch_ms" in e
+    epochs = [e for e in events if e["type"] == "epoch"]
+    assert epochs[0]["epoch"] == 0
+    assert "TotalLoss" in epochs[0]["metrics"]
+
+    # the report CLI (the artifact future BENCH/regression gates consume)
+    out = tmp_path / "report.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mx_rcnn_tpu.obs.report", str(obs_dir),
+         "--json", str(out)],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "throughput" in proc.stdout
+    blob = json.loads(out.read_text())
+    assert blob["steps"] == 4
+    assert blob["value"] > 0  # throughput (img/s) from the run
+    assert isinstance(blob["compile_count"], int)
+    assert blob["detail"]["epochs"] == 1
+    assert blob["detail"]["checkpoints"] == 1
+    assert blob["stall_count"] == 0
+
+
+@pytest.mark.compile_heavy
+def test_fit_detector_obs_disabled_writes_nothing(tmp_path):
+    """Default config: no obs directory, no JSONL — the telemetry layer
+    must be invisible when off."""
+    params = _tiny_fit(tmp_path, "ckpt2")
+    assert params is not None
+    assert not (tmp_path / "ckpt2.obs").exists()
+    assert not any(p.name.endswith(".jsonl") for p in tmp_path.rglob("*"))

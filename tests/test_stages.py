@@ -194,3 +194,25 @@ def test_alternate_training_full_pipeline(tmp_path):
     # combined checkpoint: saved at epoch 0 and eval-able (test_rcnn)
     result = stages.test_rcnn(cfg, prefix, 0)
     assert "mAP" in result and np.isfinite(result["mAP"])
+
+
+def test_bg_thresh_lo_sentinel_preset():
+    """train.bg_thresh_lo=None (the unset sentinel) gets the reference's
+    Fast-RCNN 0.1 preset on the alternate path, while an explicit value —
+    INCLUDING 0.0, which the sentinel makes expressible — is respected
+    (advisor r5: an explicit 0.0 used to be silently overwritten)."""
+    from dataclasses import replace
+
+    cfg = tiny_cfg()
+    assert cfg.train.bg_thresh_lo is None
+    assert cfg.train.bg_thresh_lo_value == 0.0  # end2end resolution
+    assert stages.apply_fast_rcnn_bg_preset(cfg).train.bg_thresh_lo == 0.1
+
+    explicit_zero = cfg.with_updates(
+        train=replace(cfg.train, bg_thresh_lo=0.0))
+    kept = stages.apply_fast_rcnn_bg_preset(explicit_zero)
+    assert kept.train.bg_thresh_lo == 0.0
+    assert kept.train.bg_thresh_lo_value == 0.0
+
+    explicit = cfg.with_updates(train=replace(cfg.train, bg_thresh_lo=0.2))
+    assert stages.apply_fast_rcnn_bg_preset(explicit).train.bg_thresh_lo == 0.2

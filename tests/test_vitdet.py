@@ -245,3 +245,27 @@ def test_streaming_attn_impl_matches_dense(rng):
     l_d, _ = jax.jit(lambda p, b, r: zoo.forward_train(
         model_d, p, b, r, cfg_d))(params, batch, key)
     assert np.isclose(float(l_s), float(l_d), rtol=1e-4), (l_s, l_d)
+
+
+def test_attn_impl_unknown_value_raises():
+    """network.attn_impl outside {'dense','streaming'} fails at build
+    time for every family (mirrors the sp_mode validation) instead of
+    being silently treated as dense (advisor r5)."""
+    bad = generate_config("resnet50", "synthetic",
+                          **{"network.attn_impl": "flash"})
+    with pytest.raises(ValueError, match="attn_impl"):
+        zoo.build_model(bad)
+
+
+def test_attn_impl_streaming_superseded_by_sp_warns(caplog):
+    """'streaming' + a sequence-parallel build: the SP kernels manage
+    their own attention, so the knob is accepted with a supersede
+    warning (mirrors the pp_stages warning)."""
+    import logging
+
+    cfg = tiny_cfg(**{"network.attn_impl": "streaming",
+                      "network.use_ring_attention": True})
+    mesh = create_mesh("1x2")
+    with caplog.at_level(logging.WARNING, logger="mx_rcnn_tpu"):
+        zoo.build_model(cfg, mesh=mesh)
+    assert any("superseded" in r.getMessage() for r in caplog.records)
