@@ -19,6 +19,16 @@ allows; PERF.md r4). Fetching the scalar's bytes cannot be faked.
 The `*_msd8` recipes drive 8 optimizer steps per host dispatch
 (train.multi_step_dispatch — one lax.scan-ed program), eliminating the
 fixed per-dispatch relay overhead instead of amortizing it with batch 2.
+The `*_flat` recipes run the flatcore storage mode (train.flat_params —
+fused flat-buffer optimizer update, train/flatcore.py), and
+`update_r101`/`update_detr` isolate the optimizer update itself (tree vs
+flat at full model size) so the ~6 ms many-buffer floor (PERF.md r4) is
+a tracked number.
+
+Crash-durability: every completed config's row is flushed to
+<obs_dir>/partial.json (MX_RCNN_BENCH_PARTIAL overrides) the moment it
+lands — an rc=124 mid-sweep keeps its finished measurements (the
+BENCH_r05 lesson).
 
 MFU: analytic FLOPs from XLA's own cost model for the whole compiled
 program (fwd+bwd+update, x8 for msd8), divided by the v5e bf16 peak
@@ -51,6 +61,7 @@ import numpy as np
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mx_rcnn_tpu.obs.events import _json_default
 from mx_rcnn_tpu.utils.compile_cache import enable_persistent_cache
 
 enable_persistent_cache()
@@ -106,6 +117,7 @@ def step_flops(compiled) -> float:
 def bench_config(cfg, reps: int = 5, iters: int = 20):
     from mx_rcnn_tpu.models.zoo import build_model, forward_train, init_params
     from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
+    from mx_rcnn_tpu.train import flatcore
     from mx_rcnn_tpu.train.optimizer import build_optimizer
     from mx_rcnn_tpu.train.step import create_train_state, make_train_step
 
@@ -117,10 +129,19 @@ def bench_config(cfg, reps: int = 5, iters: int = 20):
         iters = max(1, iters // multi)
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0))
-    tx = build_optimizer(cfg, params, steps_per_epoch=1000)
-    state = create_train_state(params, tx)
+    # flatcore recipes (train.flat_params): flat-buffer state, fused
+    # update. Built directly (init_state) — flattening a fresh tree state
+    # would round-trip every zero opt slot through the host.
+    core = None
+    if flatcore.flat_mode_for(cfg):
+        core = flatcore.FlatCore(cfg, params, steps_per_epoch=1000)
+        state = core.init_state(params)
+    else:
+        tx = build_optimizer(cfg, params, steps_per_epoch=1000)
+        state = create_train_state(params, tx)
     mesh = create_mesh(str(jax.device_count()))
-    step_fn = make_train_step(model, cfg, mesh=mesh, forward_fn=forward_train)
+    step_fn = make_train_step(model, cfg, mesh=mesh, forward_fn=forward_train,
+                              flat_core=core)
     batch = shard_batch(batch, mesh, stacked=multi > 1)
 
     rng = jax.random.PRNGKey(1)
@@ -168,6 +189,60 @@ def bench_config(cfg, reps: int = 5, iters: int = 20):
     }
 
 
+def bench_update_config(cfg, reps: int = 5, iters: int = 50):
+    """Isolated optimizer-update microbench: tree vs flat over the SAME
+    synthetic gradients at full model size — the ~6 ms many-buffer floor
+    (PERF.md r4 item 3) as a TRACKED number instead of a probe anecdote.
+    No forward/backward: the jitted program is exactly `apply_gradients`,
+    donated state, barrier = materializing the step counter's bytes."""
+    from mx_rcnn_tpu.models.zoo import build_model, init_params
+    from mx_rcnn_tpu.train import flatcore
+    from mx_rcnn_tpu.train.optimizer import build_optimizer
+    from mx_rcnn_tpu.train.step import create_train_state
+
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(7)
+    grads = jax.tree_util.tree_map(
+        lambda p: (jax.random.normal(jax.random.fold_in(key, p.size),
+                                     p.shape) * 1e-3).astype(p.dtype),
+        params)
+    tx = build_optimizer(cfg, params, steps_per_epoch=1000)
+    core = flatcore.FlatCore(cfg, params, steps_per_epoch=1000)
+    n_leaves = len(core.table.segments)
+
+    def timed(state, gr):
+        fn = jax.jit(lambda s, g: s.apply_gradients(g), donate_argnums=(0,))
+        state = fn(state, gr)  # compile + donated-layout warmup
+        for _ in range(3):
+            state = fn(state, gr)
+        float(np.asarray(state.step))
+        rates = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state = fn(state, gr)
+            float(np.asarray(state.step))  # hard barrier (module docstring)
+            rates.append(1000.0 * (time.perf_counter() - t0) / iters)
+        return statistics.median(rates)
+
+    # Flat state/grads are built BEFORE the tree timing: timed() donates
+    # its state, whose param leaves alias `params` — flattening afterwards
+    # would device_get deleted arrays.
+    flat_state = core.init_state(params)
+    fgrads = {d: jax.numpy.asarray(b)
+              for d, b in core.table.flatten(grads).items()}
+    tree_ms = timed(create_train_state(params, tx), grads)
+    flat_ms = timed(flat_state, fgrads)
+    return {
+        "tree_ms": round(tree_ms, 3),
+        "flat_ms": round(flat_ms, 3),
+        "speedup": round(tree_ms / flat_ms, 3) if flat_ms else None,
+        "param_leaves": n_leaves,
+        "optimizer": cfg.train.optimizer,
+    }
+
+
 def bench_eval_config(cfg, batch_size: int = 4, reps: int = 5,
                       iters: int = 10):
     """Inference-path throughput: the Predictor's fused detect program
@@ -209,6 +284,42 @@ def bench_eval_config(cfg, batch_size: int = 4, reps: int = 5,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "reps_img_s": [round(r, 2) for r in rates],
     }
+
+
+def flush_partial(path: str, payload: dict):
+    """Atomically (tmp + rename) persist the sweep's completed rows.
+
+    BENCH_r05 lost every completed config to an rc=124 timeout because the
+    detail dict only hit disk in the final print; now each config's result
+    lands here the moment it completes, so a killed run leaves its rows."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        # obs' last-resort coercion: an np/jnp scalar a recipe forgot to
+        # round() must degrade in place, not kill the remaining sweep
+        json.dump(payload, fh, indent=2, sort_keys=True,
+                  default=_json_default)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def run_sweep(configs: dict, runner, detail=None, elog=None,
+              flush_path=None, attempts: int = 2):
+    """Measure each config, recording errors per-row (a relay drop must
+    not lose the sweep) and flushing the accumulated detail dict to
+    `flush_path` after EVERY config."""
+    detail = {} if detail is None else detail
+    for name, cfg in configs.items():
+        for _ in range(max(1, attempts)):  # the relay occasionally drops a
+            try:                           # remote_compile mid-flight
+                detail[name] = runner(cfg)
+                break
+            except Exception as e:  # noqa: BLE001  # graftlint: disable=broad-except — record, don't lose the whole run
+                detail[name] = {"error": f"{type(e).__name__}: {e}"}
+        if elog is not None:
+            elog.emit("bench", config=name, **detail[name])
+        if flush_path:
+            flush_partial(flush_path, detail)
+    return detail
 
 
 def main():
@@ -267,16 +378,33 @@ def main():
             "image.pad_shape": (608, 1024), "train.batch_images": 1}),
         "vgg16_voc_b2": generate_config("vgg", "PascalVOC", **{
             "image.pad_shape": (608, 1024), "train.batch_images": 2}),
+        # flatcore (train/flatcore.py): full-step A/B against the plain
+        # recipes above — the fused flat update vs the per-leaf chain.
+        "c4_r101_flat": generate_config("resnet101", "coco", **{
+            "image.pad_shape": (640, 1024), "train.batch_images": 1,
+            "train.flat_params": True}),
+        "detr_r50_flat": generate_config("detr_r50", "coco", **{
+            "image.pad_shape": (640, 1024), "train.batch_images": 1,
+            "train.flat_params": True}),
     }
-    detail = {}
-    for name, cfg in configs.items():
-        for attempt in (1, 2):  # the relay occasionally drops a
-            try:                # remote_compile mid-flight; retry once
-                detail[name] = bench_config(cfg)
-                break
-            except Exception as e:  # record, don't lose the whole run
-                detail[name] = {"error": f"{type(e).__name__}: {e}"}
-        elog.emit("bench", config=name, **detail[name])
+    # Partial-results flush: every completed row lands on disk immediately
+    # (rc=124-proof; see flush_partial). The final report supersedes it.
+    flush_path = os.environ.get("MX_RCNN_BENCH_PARTIAL",
+                                os.path.join(obs_dir, "partial.json"))
+    detail = run_sweep(configs, bench_config, elog=elog,
+                       flush_path=flush_path)
+
+    # Isolated optimizer-update microbench (tree vs flat) at full model
+    # size: the ~6 ms many-buffer floor, tracked per round in the JSON
+    # and PERF.md instead of probe anecdotes.
+    update_configs = {
+        "update_r101": generate_config("resnet101", "coco", **{
+            "image.pad_shape": (640, 1024)}),
+        "update_detr": generate_config("detr_r50", "coco", **{
+            "image.pad_shape": (640, 1024)}),
+    }
+    run_sweep(update_configs, bench_update_config, detail=detail,
+              elog=elog, flush_path=flush_path)
 
     # Inference path (SURVEY §4.2 call stack: test.py → Predictor →
     # pred_eval): the jitted detect program at the test proposal budget.
@@ -286,14 +414,8 @@ def main():
         "eval_fpn_r101": generate_config("resnet101_fpn", "coco", **{
             "image.pad_shape": (640, 1024)}),
     }
-    for name, cfg in eval_configs.items():
-        for attempt in (1, 2):
-            try:
-                detail[name] = bench_eval_config(cfg)
-                break
-            except Exception as e:
-                detail[name] = {"error": f"{type(e).__name__}: {e}"}
-        elog.emit("bench", config=name, **detail[name])
+    run_sweep(eval_configs, bench_eval_config, detail=detail,
+              elog=elog, flush_path=flush_path)
 
     # Headline: best C4 recipe — same model, same shapes, same work per
     # optimizer step across recipes.

@@ -8,6 +8,7 @@ from mx_rcnn_tpu.analysis.rules import (
     cfg_contract,
     donation,
     excepts,
+    flat_state,
     host_sync,
     obs_schema,
     prng,
@@ -22,6 +23,7 @@ ALL_RULES = (
     cfg_contract,
     excepts,
     obs_schema,
+    flat_state,
 )
 
 __all__ = ["ALL_RULES"]

@@ -184,6 +184,19 @@ class TrainConfig:
     # formulation-invariant floor, PERF.md r4); this knob is a MEMORY
     # lever for big models, not a speed lever here.
     opt_state_dtype: str = "float32"
+    # flatcore (train/flatcore.py): store all trainable leaves in ONE
+    # contiguous dtype-segregated buffer per tree (params / momentum /
+    # both Adam moments) with a static segment table; the optimizer
+    # update runs as a handful of fused elementwise kernels over the
+    # flat buffers instead of hundreds of per-leaf kernels (the ~6 ms
+    # many-buffer update floor, PERF.md r4 item 3), and the DP gradient
+    # allreduce becomes one psum per buffer. Exact — parity-gated
+    # against the tree path (tests/test_flatcore.py). TP/PP configs
+    # route back to the per-leaf path (a sharded leaf has no contiguous
+    # image in a flat buffer). Checkpoints stay in TREE form on disk,
+    # interchangeable between modes. Default off until the on-chip A/B
+    # (bench.py update_* recipes) confirms the win.
+    flat_params: bool = False
     # Multi-step dispatch: each host call drives this many FULL optimizer
     # steps through one jitted lax.scan over step-stacked batches
     # (train/step.py), amortizing the fixed per-dispatch host/relay

@@ -21,11 +21,12 @@ TPU deltas:
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.data.image import (
     flip_image_and_boxes,
     load_image,
@@ -159,7 +160,15 @@ class _PrefetchIterator:
     batches are buffered. Worker exceptions are captured and re-raised in the
     consumer at that batch position (a dead loader must fail loudly, not
     hang the train loop).
+
+    Lifecycle: workers are daemon threads (an abandoned iterator can never
+    wedge interpreter exit), but `close()` is the REAL shutdown — it stops
+    the pool, drains the buffered results, and JOINS every worker, so a
+    disposed iterator leaves no thread alive (the epoch-end contract
+    tools/train.py relies on; tested in tests/test_datasets.py).
     """
+
+    _ids = iter(range(1_000_000_000))
 
     def __init__(self, make_batch, batch_indices: Sequence, depth: int = 4,
                  workers: int = 4):
@@ -172,8 +181,10 @@ class _PrefetchIterator:
         self._emitted = {}
         self._emit_cond = threading.Condition()
         self._stop = threading.Event()
-        for _ in range(max(1, workers)):
-            t = threading.Thread(target=self._worker, daemon=True)
+        pool = next(self._ids)
+        for i in range(max(1, workers)):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"loader-worker-{pool}-{i}")
             t.start()
             self._threads.append(t)
 
@@ -212,10 +223,57 @@ class _PrefetchIterator:
             yield payload
 
     def close(self):
+        """Stop, drain, and JOIN the pool. Idempotent. Workers poll the
+        stop flag every 0.1 s while waiting for a slot and exit after at
+        most one in-flight batch build, so the join is bounded by one
+        batch's assembly time."""
         self._stop.set()
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=30.0)
+                if t.is_alive():
+                    # a worker wedged inside make_batch (>30 s) breaks
+                    # the no-survivor contract — say so, don't hide it
+                    logger.warning(
+                        "loader worker %s did not join within 30s; "
+                        "leaking a daemon thread", t.name)
+        with self._emit_cond:
+            self._emitted.clear()
+            self._emit_cond.notify_all()
 
 
-class AnchorLoader:
+class _CloseableLoader:
+    """Shared shutdown surface for the batch loaders: tracks every live
+    prefetcher (overlapping iterations over the same loader each get
+    their own pool), so `close()` (or `with loader: ...`) joins all
+    worker threads even when an epoch was abandoned mid-stream.
+    Exhausting an iterator closes its prefetcher automatically; close()
+    is the explicit hook for early exits (tools/train.py epoch end)."""
+
+    _active: Tuple[_PrefetchIterator, ...] = ()
+
+    def _run_prefetch(self, it: _PrefetchIterator):
+        self._active = self._active + (it,)
+        try:
+            yield from it
+        finally:
+            it.close()
+            self._active = tuple(p for p in self._active if p is not it)
+
+    def close(self):
+        for it in self._active:
+            it.close()
+        self._active = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class AnchorLoader(_CloseableLoader):
     """Training loader: roidb → static-shape batches.
 
     Yields dicts with keys image (B,H,W,3) f32, im_info (B,3),
@@ -326,12 +384,9 @@ class AnchorLoader:
         scale_ids = (self._rng.randint(n_scales, size=nb) if n_scales > 1
                      else np.zeros(nb, np.int64))
         items = [(batches[i], int(scale_ids[i])) for i in range(nb)]
-        it = _PrefetchIterator(self._make_batch, items,
-                               depth=self._depth, workers=self._workers)
-        try:
-            yield from it
-        finally:
-            it.close()
+        yield from self._run_prefetch(
+            _PrefetchIterator(self._make_batch, items,
+                              depth=self._depth, workers=self._workers))
 
 
 class ROIIter(AnchorLoader):
@@ -372,7 +427,7 @@ class ROIIter(AnchorLoader):
         return batch
 
 
-class TestLoader:
+class TestLoader(_CloseableLoader):
     """Inference loader (reference: rcnn/core/loader.py TestLoader).
 
     Yields (batch_dict, meta) where meta carries the per-image scale and true
@@ -432,9 +487,6 @@ class TestLoader:
         if pad:
             idxs = np.concatenate([idxs, -np.ones(pad, np.int64)])
         batches = idxs.reshape(-1, self.batch_size)
-        it = _PrefetchIterator(self._make_batch, batches,
-                               depth=self._depth, workers=self._workers)
-        try:
-            yield from it
-        finally:
-            it.close()
+        yield from self._run_prefetch(
+            _PrefetchIterator(self._make_batch, batches,
+                              depth=self._depth, workers=self._workers))

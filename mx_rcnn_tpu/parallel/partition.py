@@ -75,6 +75,30 @@ TP_RULES: Tuple[Tuple[str, P], ...] = (
 )
 
 
+def flat_segment_specs(params, specs):
+    """Map per-leaf PartitionSpecs onto flatcore buffer segments.
+
+    The flat path (train/flatcore.py) concatenates leaves into one
+    replicated buffer per dtype, so it is only sound when EVERY leaf is
+    replicated — then each buffer takes ``P()`` and the DP gradient
+    allreduce is ONE psum per buffer. Any sharded leaf (the TP/PP rules
+    above) has no contiguous image inside a flat buffer: return None and
+    the caller keeps the per-leaf tree path for the whole state (mixing
+    per-segment layouts inside one buffer would force GSPMD to reshard
+    every step — worse than the many-buffer floor it replaces).
+    """
+    import jax.numpy as jnp
+
+    flat_specs = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    for spec in flat_specs:
+        if isinstance(spec, P) and any(ax is not None for ax in spec):
+            return None
+    dtypes = {jnp.dtype(leaf.dtype).name
+              for leaf in jax.tree_util.tree_leaves(params)}
+    return {d: P() for d in sorted(dtypes)}
+
+
 def _path_str(path) -> str:
     parts = []
     for entry in path:

@@ -96,6 +96,11 @@ def fit_detector(
     save is enqueued, not durable, when epoch_callback runs — a callback
     that READS the just-saved checkpoint from disk must not assume it has
     landed (it is durable by the next epoch's save and before return).
+
+    epoch_callback(epoch, state, bag): with train.flat_params the state is
+    a FlatTrainState — `.step` and `.params` (host-owned copies) keep
+    working, but there is no `.opt_state` tree; use the checkpoint for
+    optimizer inspection.
     """
     from mx_rcnn_tpu.parallel.distributed import is_primary, local_data_shards
 
@@ -162,6 +167,7 @@ def fit_detector(
     # offset by begin_step instead (never both — that would double-count).
     resume_epoch = latest_epoch(prefix) if resume else None
     opt_state = None
+    sched_begin = 0
     if resume_epoch is not None:
         begin_epoch = resume_epoch
         tx = build_optimizer(cfg, params, steps_per_epoch)
@@ -175,11 +181,13 @@ def fit_detector(
                     resume_epoch, "restored" if opt_state is not None
                     else "reinitialized")
         if opt_state is None:
+            sched_begin = begin_epoch * steps_per_epoch
             tx = build_optimizer(cfg, params, steps_per_epoch,
-                                 begin_step=begin_epoch * steps_per_epoch)
+                                 begin_step=sched_begin)
     else:
+        sched_begin = begin_epoch * steps_per_epoch
         tx = build_optimizer(cfg, params, steps_per_epoch,
-                             begin_step=begin_epoch * steps_per_epoch)
+                             begin_step=sched_begin)
 
     state = create_train_state(params, tx)
     if opt_state is not None:
@@ -202,9 +210,38 @@ def fit_detector(
                 "network.tensor_parallel ignored: mesh model axis is 1 "
                 "(build the mesh as '<data>x<model>', e.g. --tpu-mesh 4x2)")
 
+    # flatcore (train/flatcore.py): persistent flat parameter/optimizer
+    # storage — the update becomes a handful of fused kernels and the DP
+    # allreduce one psum per buffer. TP/PP (sharded-leaf) runs route back
+    # to the per-leaf path inside flat_mode_for. Checkpoints stay in TREE
+    # form on disk (tree_state below), so the restore above and every
+    # other consumer are mode-agnostic.
+    flat_core = None
+    if getattr(cfg.train, "flat_params", False):
+        from mx_rcnn_tpu.train import flatcore as _flatcore
+
+        if _flatcore.flat_mode_for(cfg, params=state.params,
+                                   param_specs=param_specs):
+            flat_core = _flatcore.FlatCore(cfg, state.params,
+                                           steps_per_epoch,
+                                           begin_step=sched_begin)
+            if opt_state is not None:
+                state = flat_core.flatten_state(state)
+            else:
+                # Fresh slots: build the flat state directly —
+                # flatten_state would device_get every zero leaf of the
+                # per-leaf opt_state just to re-upload it as flat zeros.
+                state = flat_core.init_state(state.params).replace(
+                    step=jax.numpy.asarray(state.step, jax.numpy.int32))
+            logger.info(
+                "flatcore: %d leaves -> %d flat buffer(s) %s",
+                len(flat_core.table.segments), len(flat_core.table.sizes),
+                {d: n for d, n in flat_core.table.sizes.items()})
+
     step_fn = make_train_step(model, cfg, mesh=mesh,
                               forward_fn=forward_fn or forward_train,
-                              param_specs=param_specs)
+                              param_specs=param_specs,
+                              flat_core=flat_core)
     rng = jax.random.PRNGKey(seed + 1)
     multi = max(1, cfg.train.multi_step_dispatch)
     if multi > 1 and len(loader) % multi:
@@ -239,9 +276,21 @@ def fit_detector(
     # cross-process commit barrier would hang with one caller).
     writer = None
     if cfg.train.async_checkpoint and jax.process_count() == 1:
-        from mx_rcnn_tpu.train.checkpoint import CheckpointWriter
+        if flat_core is not None and jax.default_backend() == "cpu":
+            # Flat mode on the CPU backend: the background tensorstore
+            # write racing the flat step's large host-buffer churn
+            # (113+ MB donated buffers and backward concatenates every
+            # step) crashes in the native allocator — reproduced as
+            # free(): invalid pointer under MALLOC_CHECK_ with flat+async
+            # only; tree+async and flat+sync run clean. On TPU the step's
+            # buffers live in HBM, not host malloc, so async stays on.
+            logger.info("flatcore on CPU backend: epoch checkpoints go "
+                        "synchronous (async writer would race the flat "
+                        "step's host allocator)")
+        else:
+            from mx_rcnn_tpu.train.checkpoint import CheckpointWriter
 
-        writer = CheckpointWriter()
+            writer = CheckpointWriter()
 
     try:
         for epoch in range(begin_epoch, end_epoch):
@@ -262,10 +311,22 @@ def fit_detector(
             # checkpoint_period > 1 (long small-epoch runs, e.g. the DETR
             # gate's 150 epochs): save every Nth epoch and always the last —
             # resume granularity traded against orbax save time.
+            # Explicit loader shutdown at epoch end: the epoch generator's
+            # finally already STOPPED the prefetcher when the loop drained
+            # it; close() additionally joins the worker threads so none
+            # outlive the epoch (data/loader.py).
+            if hasattr(loader, "close"):
+                loader.close()
             if is_primary() and ((epoch + 1) % max(1, checkpoint_period) == 0
                                  or epoch + 1 == end_epoch):
+                if flat_core is not None:
+                    # on-disk form is ALWAYS the tree form — checkpoints
+                    # stay interchangeable between flat and tree modes
+                    save_params, save_opt = flat_core.tree_state(state)
+                else:
+                    save_params, save_opt = state.params, state.opt_state
                 save = writer.save if writer is not None else save_checkpoint
-                save(prefix, epoch + 1, state.params, state.opt_state,
+                save(prefix, epoch + 1, save_params, save_opt,
                      means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
                      num_classes=cfg.dataset.num_classes)
                 if obs_log.enabled:
@@ -289,4 +350,9 @@ def fit_detector(
         obs_log.close()
         if writer is not None:
             writer.close()  # the last save must be durable before return
+        if hasattr(loader, "close"):
+            loader.close()  # crash paths must not leak worker threads
+    # In flat mode, FlatTrainState.params is already a host-owned copy
+    # tree (never views of the donated device buffers); device_get is
+    # then a pass-through.
     return jax.device_get(state.params)

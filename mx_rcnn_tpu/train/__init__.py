@@ -8,6 +8,7 @@ pjit-able train step, host-side metric accumulators, and orbax checkpoints.
 
 from mx_rcnn_tpu.train.optimizer import build_optimizer, trainable_mask
 from mx_rcnn_tpu.train.step import TrainState, create_train_state, make_train_step
+from mx_rcnn_tpu.train.flatcore import FlatCore, FlatTrainState
 from mx_rcnn_tpu.train.metrics import MetricBag
 from mx_rcnn_tpu.train.callback import Speedometer
 
@@ -17,6 +18,8 @@ __all__ = [
     "TrainState",
     "create_train_state",
     "make_train_step",
+    "FlatCore",
+    "FlatTrainState",
     "MetricBag",
     "Speedometer",
 ]

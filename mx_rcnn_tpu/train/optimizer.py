@@ -21,9 +21,10 @@ Mapping:
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Dict, Mapping, Sequence
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from mx_rcnn_tpu.config import Config
@@ -136,3 +137,92 @@ def build_optimizer(cfg: Config, params, steps_per_epoch: int = 1000,
         lambda t: "train" if t else "frozen", mask)
     return optax.multi_transform(
         {"train": inner, "frozen": optax.set_to_zero()}, labels)
+
+
+# ---------------------------------------------------------------------------
+# Flat update path (train/flatcore.py storage). The r4 probes showed the
+# ~6 ms update floor is a serialization cost of launching hundreds of
+# per-leaf kernels, not HBM bandwidth — so the structural fix is fewer,
+# bigger buffers, not cheaper per-leaf math. These functions are the
+# elementwise twins of the optax chains above, applied to flatcore's
+# dtype-segregated buffers ({dtype-name: 1-D array}): a handful of fused
+# kernels per step instead of one-per-leaf-per-transform. Freezing is a
+# precomputed per-segment 0/1 scale (`masks`) multiplied into the gradient
+# AND the weight-decay term — the same hard-zero semantics as the
+# multi_transform above (the r3 frozen-grad fix): frozen elements see a
+# structurally zero update, so `p + (-lr * 0)` leaves them bit-identical.
+# ---------------------------------------------------------------------------
+
+
+def flat_sgd_update(params: Mapping[str, jnp.ndarray],
+                    grads: Mapping[str, jnp.ndarray],
+                    trace: Mapping[str, jnp.ndarray],
+                    masks: Mapping[str, jnp.ndarray], *,
+                    lr, momentum: float, wd: float, clip_delta: float,
+                    trace_dtypes: Mapping[str, str]):
+    """clip → add_decayed_weights → trace → (−lr), fused over flat buffers.
+
+    Expression-for-expression the optax chain in build_optimizer (clip of a
+    hard-zeroed gradient is zero; the trace buffer covers frozen segments
+    but stays exactly 0 there), so the trainable elements are BIT-identical
+    to the tree path — same elementwise ops in the same order, just over
+    one buffer per dtype. `trace_dtypes` mirrors optax.trace's
+    accumulator_dtype (the opt_state_dtype memory lever): the update uses
+    the uncast value; the stored slot is cast.
+    """
+    new_p: Dict[str, jnp.ndarray] = {}
+    new_t: Dict[str, jnp.ndarray] = {}
+    for d, p in params.items():
+        m = masks[d]
+        u = jnp.clip(grads[d] * m, -clip_delta, clip_delta)
+        u = u + wd * (p * m)
+        t_new = u + momentum * trace[d]
+        step = jnp.asarray(-1.0, t_new.dtype) * jnp.asarray(
+            lr, t_new.dtype) * t_new
+        new_p[d] = jnp.asarray(p + step).astype(p.dtype)
+        new_t[d] = t_new.astype(trace_dtypes[d])
+    return new_p, new_t
+
+
+def flat_adamw_update(params: Mapping[str, jnp.ndarray],
+                      grads: Mapping[str, jnp.ndarray],
+                      mu: Mapping[str, jnp.ndarray],
+                      nu: Mapping[str, jnp.ndarray],
+                      masks: Mapping[str, jnp.ndarray],
+                      count_inc, *,
+                      lr, wd: float, max_norm: float,
+                      b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                      mu_dtypes: Mapping[str, str]):
+    """clip_by_global_norm → scale_by_adam → +wd·p → (−lr), flat twin.
+
+    The global norm reduces over the masked buffers (= the trainable
+    leaves, exactly what the multi_transform 'train' partition feeds
+    optax's clip) — per-BUFFER partial sums instead of per-leaf, so the
+    reduction order differs by float rounding only. Everything after is
+    elementwise. `count_inc` is the POST-increment optax step count
+    (scale_by_adam's safe_int32_increment result) — FlatCore.apply
+    computes the bump once and stores the same value, so the bias
+    correction here and the schedule count can never desynchronize.
+    """
+    g = {d: grads[d] * masks[d] for d in grads}
+    gn = jnp.sqrt(sum(jnp.sum(jnp.square(v)) for v in g.values()))
+    trigger = gn < max_norm
+    bc1 = 1 - b1 ** count_inc
+    bc2 = 1 - b2 ** count_inc
+    new_p: Dict[str, jnp.ndarray] = {}
+    new_mu: Dict[str, jnp.ndarray] = {}
+    new_nu: Dict[str, jnp.ndarray] = {}
+    for d, p in params.items():
+        gc = jax.lax.select(trigger, g[d],
+                            (g[d] / gn.astype(g[d].dtype)) * max_norm)
+        mu_new = (1 - b1) * gc + b1 * mu[d]
+        nu_new = (1 - b2) * (gc ** 2) + b2 * nu[d]
+        mu_hat = mu_new / bc1.astype(mu_new.dtype)
+        nu_hat = nu_new / bc2.astype(nu_new.dtype)
+        u = mu_hat / (jnp.sqrt(nu_hat + 0.0) + eps)
+        u = u + wd * (p * masks[d])
+        u = jnp.asarray(-1.0, u.dtype) * jnp.asarray(lr, u.dtype) * u
+        new_p[d] = jnp.asarray(p + u).astype(p.dtype)
+        new_mu[d] = mu_new.astype(mu_dtypes[d])
+        new_nu[d] = nu_new
+    return new_p, new_mu, new_nu

@@ -97,6 +97,7 @@ def make_train_step(
     donate: bool = True,
     forward_fn: Callable = forward_train,
     param_specs=None,
+    flat_core=None,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray], jax.Array],
               Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the jitted train step.
@@ -117,22 +118,34 @@ def make_train_step(
     The state must then arrive PRE-PLACED (shard_train_state) — shardings
     are inferred from the committed inputs and propagated by GSPMD, which
     inserts the TP collectives alongside the data-axis gradient psum.
+
+    flat_core (train/flatcore.py): state is a FlatTrainState; the loss is
+    differentiated with respect to the FLAT BUFFERS — the param tree the
+    forward sees is slice/reshape views materialized in-graph, so the
+    backward writes one flat gradient per dtype and the DP allreduce is
+    one psum per buffer. Donation, grad accumulation and multi-step
+    dispatch compose unchanged (the flat state is an ordinary pytree).
     """
 
     accum = max(1, int(getattr(cfg.train, "grad_accum_steps", 1)))
     multi = max(1, int(getattr(cfg.train, "multi_step_dispatch", 1)))
+    as_params = (flat_core.table.unflatten if flat_core is not None
+                 else (lambda p: p))
 
-    def _grads_of(params, chunk, key):
+    def _grads_of(diff, chunk, key):
         def loss_fn(p):
-            loss, aux = forward_fn(model, p, chunk, key, cfg)
+            loss, aux = forward_fn(model, as_params(p), chunk, key, cfg)
             return loss, aux
 
-        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(diff)
         return grads, _metric_parts(aux)
+
+    def _diff_of(state):
+        return state.flat if flat_core is not None else state.params
 
     def _one_update(state: TrainState, batch, rng):
         if accum == 1:
-            grads, parts = _grads_of(state.params, batch, rng)
+            grads, parts = _grads_of(_diff_of(state), batch, rng)
         else:
             # Micro-step accumulation: the batch's leading dim is
             # accum x micro-batch; grads average and metric PARTS sum
@@ -154,7 +167,7 @@ def make_train_step(
             g_tot, p_tot = None, None
             for i in range(accum):
                 chunk = jax.tree.map(lambda x: x[:, i], chunks)
-                g, p = _grads_of(state.params, chunk, keys[i])
+                g, p = _grads_of(_diff_of(state), chunk, keys[i])
                 if g_tot is None:
                     g_tot, p_tot = g, p
                 else:

@@ -691,3 +691,62 @@ def test_obs_schema_near_miss_known_literals_and_foreign_emit():
             signal.emit("anything")   # Qt-style signal — out of scope
     """)
     assert "obs-event-schema" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# flat-state-access
+# ---------------------------------------------------------------------------
+
+def test_flat_state_flags_opt_state_subscript_in_jit():
+    findings = lint("""
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, grads):
+            trace = state.opt_state[1][0]   # optax chain-position poking
+            return trace
+    """)
+    assert sum(f.rule == "flat-state-access" for f in findings) == 1
+
+
+def test_flat_state_flags_bare_name_in_jitted_closure():
+    findings = lint("""
+        import jax
+
+        def make(opt_state):
+            def inner(x):
+                return x + opt_state[0].count
+
+            return jax.jit(inner)
+    """)
+    assert "flat-state-access" in rules_of(findings)
+
+
+def test_flat_state_near_miss_host_side_and_tree_map():
+    findings = lint("""
+        import functools
+
+        import jax
+
+        def restore(opt_state):
+            return opt_state[0]        # host-side conversion — fine
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, grads):
+            # whole-tree access is layout-agnostic — fine
+            return jax.tree.map(lambda t: t * 0.9, state.opt_state)
+    """)
+    assert "flat-state-access" not in rules_of(findings)
+
+
+def test_flat_state_near_miss_template_names():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def f(opt_state_template):
+            return opt_state_template["params"]
+    """)
+    assert "flat-state-access" not in rules_of(findings)

@@ -1,0 +1,129 @@
+"""bench.py partial-results flush (the BENCH_r05 rc=124 lesson).
+
+Every completed config's row must be on disk BEFORE the next one starts,
+so a killed sweep (TPU outage, driver timeout) keeps its finished
+measurements. Covered two ways: in-process (the flush file is readable
+and complete after every row) and for real — a subprocess SIGKILLs itself
+mid-sweep and the completed rows are found on disk.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def test_bench_update_config_produces_numbers():
+    """The update microbench must yield real tree AND flat timings — a
+    donation-ordering bug once deleted the param arrays before the flat
+    state was built, so both update_* recipes silently recorded errors."""
+    from dataclasses import replace
+
+    from mx_rcnn_tpu.config import generate_config
+
+    cfg = generate_config("resnet50", "synthetic", **{
+        "train.rpn_pre_nms_top_n": 128, "train.rpn_post_nms_top_n": 32,
+        "train.batch_rois": 16, "train.max_gt_boxes": 4,
+        "train.batch_images": 1, "network.anchor_scales": (2, 4),
+        "image.pad_shape": (64, 64)})
+    cfg = cfg.with_updates(
+        network=replace(cfg.network, compute_dtype="float32"))
+    out = bench.bench_update_config(cfg, reps=1, iters=2)
+    assert out["tree_ms"] > 0 and out["flat_ms"] > 0
+    assert out["param_leaves"] > 100
+    assert out["optimizer"] == "sgd"
+
+
+def test_run_sweep_flushes_after_every_config(tmp_path):
+    flush = str(tmp_path / "partial.json")
+    seen = []
+
+    def runner(cfg):
+        if seen:  # previous rows must already be durable
+            with open(flush, "r", encoding="utf-8") as fh:
+                on_disk = json.load(fh)
+            assert all(k in on_disk for k in seen), (seen, on_disk)
+        if cfg == "boom":
+            raise RuntimeError("relay dropped")
+        seen.append(cfg)
+        return {"img_s_per_chip": 1.0, "which": cfg}
+
+    detail = bench.run_sweep({"a": "a", "b": "boom", "c": "c"}, runner,
+                             flush_path=flush, attempts=1)
+    with open(flush, "r", encoding="utf-8") as fh:
+        on_disk = json.load(fh)
+    assert set(on_disk) == {"a", "b", "c"}
+    assert on_disk["b"]["error"].startswith("RuntimeError")
+    assert detail == on_disk
+
+
+def test_run_sweep_retries_then_records_error(tmp_path):
+    calls = []
+
+    def runner(cfg):
+        calls.append(cfg)
+        raise ValueError("always down")
+
+    detail = bench.run_sweep({"x": "x"}, runner, attempts=2)
+    assert len(calls) == 2  # one retry, like the relay-drop policy
+    assert "error" in detail["x"]
+
+
+def test_flush_partial_is_atomic(tmp_path):
+    path = str(tmp_path / "p.json")
+    bench.flush_partial(path, {"a": 1})
+    bench.flush_partial(path, {"a": 1, "b": 2})
+    with open(path, "r", encoding="utf-8") as fh:
+        assert json.load(fh) == {"a": 1, "b": 2}
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_flush_partial_coerces_non_json_values(tmp_path):
+    """A row with a stray np scalar must degrade in place, not raise and
+    kill the rest of the sweep."""
+    import numpy as np
+
+    path = str(tmp_path / "p.json")
+    bench.flush_partial(path, {"a": {"ms": np.float32(1.5),
+                                     "n": np.int64(3)}})
+    with open(path, "r", encoding="utf-8") as fh:
+        row = json.load(fh)["a"]
+    assert row["ms"] == 1.5 and row["n"] == 3
+
+
+def test_partial_rows_survive_sigkill(tmp_path):
+    """The acceptance gate: kill the run mid-sweep, find the completed
+    rows on disk. SIGKILL (no atexit, no finally) is the honest analog of
+    the rc=124 outage that ate BENCH_r05."""
+    flush = str(tmp_path / "partial.json")
+    script = textwrap.dedent(f"""
+        import os, signal, sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import bench
+
+        def runner(cfg):
+            if cfg == "die":
+                os.kill(os.getpid(), signal.SIGKILL)
+            return {{"img_s_per_chip": 2.0, "which": cfg}}
+
+        bench.run_sweep({{"first": "first", "die": "die", "never": "never"}},
+                        runner, flush_path={flush!r}, attempts=1)
+        print("UNREACHABLE")
+    """)
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, env=env,
+                          timeout=110)
+    assert proc.returncode == -9, (proc.returncode, proc.stderr[-2000:])
+    assert "UNREACHABLE" not in proc.stdout
+    with open(flush, "r", encoding="utf-8") as fh:
+        on_disk = json.load(fh)
+    assert on_disk == {"first": {"img_s_per_chip": 2.0, "which": "first"}}

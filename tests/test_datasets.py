@@ -308,3 +308,76 @@ def test_gt_roidb_cache_distinguishes_dataset_paths(tmp_path):
     assert len(ds_a.gt_roidb()) == 3
     assert len(ds_b.gt_roidb()) == 5  # not the cached 3-entry roidb
     assert len(ds_a.gt_roidb()) == 3  # both caches coexist
+
+
+# ---------------------------------------------------------------------------
+# loader shutdown (data/loader.py close/context-manager contract)
+# ---------------------------------------------------------------------------
+
+
+def _worker_threads():
+    import threading
+
+    return [t for t in threading.enumerate()
+            if t.name.startswith("loader-worker") and t.is_alive()]
+
+
+def _synthetic_loader(n=6):
+    from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
+
+    cfg = generate_config("resnet50", "synthetic", **{
+        "image.pad_shape": (64, 64), "image.scales": ((64, 64),),
+        "train.batch_images": 1, "train.flip": False,
+        "train.max_gt_boxes": 4})
+    ds = SyntheticDataset("train", num_images=n, image_size=64,
+                          max_objects=1, min_size_frac=3, max_size_frac=2)
+    return AnchorLoader(ds.gt_roidb(), cfg, num_shards=1, seed=0)
+
+
+def test_loader_close_joins_workers():
+    """close() stops AND joins the prefetch pool: no loader worker thread
+    survives, even when the epoch was abandoned mid-stream."""
+    loader = _synthetic_loader()
+    it = iter(loader)
+    next(it)
+    assert _worker_threads(), "prefetch pool never started"
+    loader.close()
+    assert not _worker_threads(), "worker threads survived close()"
+    # close() is idempotent and the loader is reusable for a fresh epoch
+    loader.close()
+    assert sum(1 for _ in loader) == 6
+    assert not _worker_threads()
+
+
+def test_loader_iterator_disposal_joins_workers():
+    """Disposing the epoch generator (the for-loop breaking out, or GC)
+    runs the generator's finally — which closes AND joins the pool."""
+    import gc
+
+    loader = _synthetic_loader()
+    it = iter(loader)
+    next(it)
+    del it
+    gc.collect()
+    assert not _worker_threads(), "worker threads survived disposal"
+
+
+def test_loader_close_joins_overlapping_iterations():
+    """Two live iterations over the same loader each own a pool; close()
+    must join BOTH (a single-slot tracker would orphan the first)."""
+    loader = _synthetic_loader()
+    it1 = iter(loader)
+    next(it1)
+    it2 = iter(loader)
+    next(it2)
+    loader.close()
+    assert not _worker_threads(), "a pool survived close()"
+
+
+def test_loader_context_manager():
+    with _synthetic_loader() as loader:
+        for i, batch in enumerate(loader):
+            assert np.isfinite(batch["image"]).all()
+            if i == 1:
+                break  # abandon mid-epoch; __exit__ must clean up
+    assert not _worker_threads()
