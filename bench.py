@@ -52,6 +52,7 @@ import json
 import os
 import statistics
 import time
+from typing import Optional
 
 import jax
 import numpy as np
@@ -303,18 +304,37 @@ def flush_partial(path: str, payload: dict):
 
 
 def run_sweep(configs: dict, runner, detail=None, elog=None,
-              flush_path=None, attempts: int = 2):
+              flush_path=None, attempts: int = 2,
+              timeout_s: Optional[float] = None):
     """Measure each config, recording errors per-row (a relay drop must
     not lose the sweep) and flushing the accumulated detail dict to
-    `flush_path` after EVERY config."""
+    `flush_path` after EVERY config.
+
+    timeout_s arms graftguard deadline isolation (resilience/isolate.py):
+    each config runs in a spawn child with a per-config deadline, so a
+    hung compile forfeits ONE row (a structured timeout row) instead of
+    the whole sweep — the BENCH_r05 rc=124 failure mode. A timeout is
+    never retried (a hung compile would just hang again); child error
+    rows get the same `attempts` retry as in-process exceptions. The
+    children share the persistent compile cache, but their XLA compiles
+    are no longer visible to the parent's compile_track. timeout_s=None
+    keeps the in-process path (unit tests; trusted local runs)."""
     detail = {} if detail is None else detail
     for name, cfg in configs.items():
         for _ in range(max(1, attempts)):  # the relay occasionally drops a
-            try:                           # remote_compile mid-flight
-                detail[name] = runner(cfg)
-                break
-            except Exception as e:  # noqa: BLE001  # graftlint: disable=broad-except — record, don't lose the whole run
-                detail[name] = {"error": f"{type(e).__name__}: {e}"}
+            if timeout_s is not None:      # remote_compile mid-flight
+                from mx_rcnn_tpu.resilience.isolate import run_with_deadline
+
+                detail[name] = run_with_deadline(runner, cfg, timeout_s,
+                                                 label=name)
+                if "timeout_s" in detail[name] or "error" not in detail[name]:
+                    break
+            else:
+                try:
+                    detail[name] = runner(cfg)
+                    break
+                except Exception as e:  # noqa: BLE001  # graftlint: disable=broad-except — record, don't lose the whole run
+                    detail[name] = {"error": f"{type(e).__name__}: {e}"}
         if elog is not None:
             elog.emit("bench", config=name, **detail[name])
         if flush_path:
@@ -333,8 +353,41 @@ def main():
     # (PERF.md). Override the directory with MX_RCNN_BENCH_OBS.
     obs_dir = os.environ.get("MX_RCNN_BENCH_OBS", "bench_obs")
     elog = open_event_log(obs_dir, fresh=True)  # per-run artifact
+
+    # graftguard: ride out a transient relay outage (classified retry
+    # with backoff under a deadline) BEFORE the first device touch —
+    # run_meta below reads jax.default_backend(), so acquisition must
+    # come first or a silent CPU fallback gets cached unguarded. Leaves
+    # backend_retry events in the report (OUTAGES.md).
+    # MX_RCNN_BENCH_BACKEND_DEADLINE_S overrides the 12 h default — a CI
+    # bench should give up in minutes, not burn its wall clock; 0 skips
+    # acquisition entirely (raw first-touch jax behavior).
+    # MX_RCNN_BENCH_BACKEND_PLATFORM=tpu arms the silent-CPU-fallback
+    # guard: without it a relay-less box would record CPU rows as 'TPU'
+    # numbers (resilience/backend.py::_check_platform).
+    from mx_rcnn_tpu.config import ResilienceConfig
+    from mx_rcnn_tpu.resilience import acquire_backend
+
+    rkw = {}
+    backend_deadline = os.environ.get("MX_RCNN_BENCH_BACKEND_DEADLINE_S")
+    if backend_deadline is not None:
+        rkw["backend_deadline_s"] = float(backend_deadline)
+    platform = os.environ.get("MX_RCNN_BENCH_BACKEND_PLATFORM")
+    if platform:
+        rkw["backend_platform"] = platform
+    rcfg = ResilienceConfig(**rkw)
+    if rcfg.backend_deadline_s > 0:
+        acquire_backend(rcfg, elog=elog)
     elog.emit("run_meta", **run_meta_fields(None, tool="bench"))
     compile_track.activate(elog)
+
+    # Per-config deadline (graftguard isolation, resilience/isolate.py):
+    # each config runs in a killable spawn child, so a hung compile
+    # (BENCH_r05: one rc=124 ate the whole sweep) forfeits one row.
+    # MX_RCNN_BENCH_DEADLINE_S overrides; 0 disables isolation (runs
+    # in-process — compile events then land in this run's report).
+    deadline_s = float(os.environ.get("MX_RCNN_BENCH_DEADLINE_S", "1800"))
+    timeout_s = deadline_s if deadline_s > 0 else None
 
     # Flagship shapes: (600,1000)-scale COCO canvas padded to 640x1024,
     # full train proposal path. All five BASELINE families; C4 and FPN at
@@ -392,7 +445,7 @@ def main():
     flush_path = os.environ.get("MX_RCNN_BENCH_PARTIAL",
                                 os.path.join(obs_dir, "partial.json"))
     detail = run_sweep(configs, bench_config, elog=elog,
-                       flush_path=flush_path)
+                       flush_path=flush_path, timeout_s=timeout_s)
 
     # Isolated optimizer-update microbench (tree vs flat) at full model
     # size: the ~6 ms many-buffer floor, tracked per round in the JSON
@@ -404,7 +457,7 @@ def main():
             "image.pad_shape": (640, 1024)}),
     }
     run_sweep(update_configs, bench_update_config, detail=detail,
-              elog=elog, flush_path=flush_path)
+              elog=elog, flush_path=flush_path, timeout_s=timeout_s)
 
     # Inference path (SURVEY §4.2 call stack: test.py → Predictor →
     # pred_eval): the jitted detect program at the test proposal budget.
@@ -415,7 +468,7 @@ def main():
             "image.pad_shape": (640, 1024)}),
     }
     run_sweep(eval_configs, bench_eval_config, detail=detail,
-              elog=elog, flush_path=flush_path)
+              elog=elog, flush_path=flush_path, timeout_s=timeout_s)
 
     # Headline: best C4 recipe — same model, same shapes, same work per
     # optimizer step across recipes.

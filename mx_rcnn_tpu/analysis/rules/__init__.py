@@ -12,6 +12,7 @@ from mx_rcnn_tpu.analysis.rules import (
     host_sync,
     obs_schema,
     prng,
+    retry,
     shapes,
 )
 
@@ -24,6 +25,7 @@ ALL_RULES = (
     excepts,
     obs_schema,
     flat_state,
+    retry,
 )
 
 __all__ = ["ALL_RULES"]

@@ -347,6 +347,42 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """graftguard fault tolerance (mx_rcnn_tpu/resilience — classified
+    backend acquisition, preemption-safe training, deadline-isolated
+    benching, chaos injection). Runbook: OUTAGES.md."""
+
+    # Acquire the backend through resilience/backend.py: transient
+    # failures (UNAVAILABLE — the TPU_OUTAGE_r5 signature) retry with
+    # exponential backoff + jitter under the deadline below; permanent
+    # errors fail fast. False = raw first-touch jax behavior.
+    backend_acquire: bool = True
+    # Require this platform in the acquired device list ("tpu" for real
+    # runs): jax can silently fall back to CPU when the relay is down —
+    # the probe then "succeeds" instantly and a multi-hour run proceeds
+    # at CPU speed. When set, a fallback device list is classified as a
+    # transient failure (backend cache cleared, retried under the
+    # deadline). "" accepts whatever comes up (CPU tests/dev boxes).
+    backend_platform: str = ""
+    # Give up after this long of CONTINUOUS transient failure (the r5
+    # outage lasted ~11 h; 12 h rides out a same-shaped one).
+    backend_deadline_s: float = 43200.0
+    backend_backoff_base_s: float = 2.0
+    backend_backoff_max_s: float = 300.0
+    # Multiplicative jitter fraction on every sleep (decorrelates a fleet
+    # of hosts re-probing a recovering relay).
+    backend_backoff_jitter: float = 0.25
+    # Install SIGTERM/SIGINT handlers that request a checkpoint at the
+    # next step boundary and exit with the resumable rc (75) instead of
+    # dying mid-step (resilience/preempt.py).
+    preempt_handlers: bool = True
+    # On preemption, write a step-granular emergency checkpoint (a
+    # dispatch-tagged dir under the prefix; picked up by --resume auto).
+    # False: exit resumable-rc without saving (epoch checkpoints only).
+    preempt_save: bool = True
+
+
+@dataclass(frozen=True)
 class Config:
     network: NetworkConfig = field(default_factory=NetworkConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
@@ -355,6 +391,7 @@ class Config:
     image: ImageConfig = field(default_factory=ImageConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     seed: int = 0
 
     def with_updates(self, **kw) -> "Config":

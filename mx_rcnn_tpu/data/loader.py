@@ -298,12 +298,26 @@ class AnchorLoader(_CloseableLoader):
         self.global_batch_size = self.batch_size * process_count
         self.shuffle = cfg.train.shuffle if shuffle is None else shuffle
         self.aspect_grouping = cfg.train.aspect_grouping
+        self._seed = seed
         self._rng = np.random.RandomState(seed)
         self._depth = prefetch_depth
         self._workers = workers
 
     def __len__(self):
         return len(self.roidb) // self.global_batch_size
+
+    def set_epoch(self, epoch: int):
+        """Reseed the order rng as a pure function of (seed, epoch) — the
+        distributed-sampler idiom. fit_detector calls this at every epoch
+        start so the epoch's batch order (and scale-bucket draw) is
+        reproducible in isolation: a run resumed at epoch E (or mid-epoch
+        via a graftguard emergency save, which SKIPS the already-trained
+        prefix) replays exactly the order the uninterrupted run saw —
+        the bit-exact kill→resume parity gate depends on it. Multi-host:
+        identical on every process (same seed, same epoch). Standalone
+        iteration without set_epoch keeps the legacy advancing stream."""
+        self._rng = np.random.RandomState(
+            (self._seed * 1_000_003 + epoch) % (2 ** 32))
 
     def _epoch_order(self) -> np.ndarray:
         n = len(self.roidb)

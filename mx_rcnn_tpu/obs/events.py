@@ -50,6 +50,11 @@ EVENT_TYPES = (
     "stall",       # watchdog: no step completed within the stall threshold
     "crash",       # unhandled exception in the train loop (re-raised)
     "bench",       # one bench.py config measurement
+    "backend_retry",  # graftguard: transient backend failure; sleeping
+                      # sleep_s before attempt+1 (resilience/backend.py)
+    "backend_up",  # graftguard: backend acquired (attempts, waited_s)
+    "preempt",     # SIGTERM/SIGINT honored at a step boundary; emergency
+                   # checkpoint state in `saved` (resilience/preempt.py)
 )
 
 #: Buffered kinds — everything else flushes to disk immediately, so the

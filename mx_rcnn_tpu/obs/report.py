@@ -117,6 +117,19 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                                 "step", "config")}
                   for i, e in enumerate(by_type.get("bench", ()))},
         "stalls": len(by_type.get("stall", ())),
+        # graftguard: how hard the backend fought acquisition, and whether
+        # the run was preempted (OUTAGES.md reads these three lines first).
+        "backend": {
+            "retries": len(by_type.get("backend_retry", ())),
+            "retry_wait_s": round(sum(
+                e.get("sleep_s", 0.0)
+                for e in by_type.get("backend_retry", ())), 3),
+            "last_error": (by_type["backend_retry"][-1].get("error")
+                           if by_type.get("backend_retry") else None),
+        },
+        "preempts": [{"signal": e.get("signal"), "step": e.get("step"),
+                      "saved": e.get("saved")}
+                     for e in by_type.get("preempt", ())],
         "crash": ({"error": crash.get("error"), "step": crash.get("step")}
                   if crash else None),
     }
@@ -135,6 +148,7 @@ def bench_blob(summary: Dict[str, Any]) -> Dict[str, Any]:
         "compile_total_ms": summary["compile"]["total_ms"],
         "data_wait_fraction": summary["data_wait"]["fraction"],
         "stall_count": summary["stalls"],
+        "backend_retries": summary["backend"]["retries"],
         "detail": summary,
     }
 
@@ -160,6 +174,15 @@ def render(summary: Dict[str, Any]) -> str:
         f"{co['steady_state_count']} in steady state",
         f"  stalls:     {summary['stalls']}",
     ]
+    be = summary.get("backend", {})
+    if be.get("retries"):
+        lines.append(
+            f"  backend:    {be['retries']} transient failure(s), "
+            f"{be['retry_wait_s']:.0f}s backing off | last: "
+            f"{be['last_error']}")
+    for p in summary.get("preempts", ()):
+        lines.append(f"  preempt:    signal {p['signal']} at step "
+                     f"{p['step']} (emergency save: {p['saved']})")
     for name, row in summary["bench"].items():
         lines.append(f"  bench:      {name}: {row}")
     if summary["crash"]:

@@ -60,16 +60,19 @@ class StepTimer:
         if self.log.enabled:
             self._t_dispatch = time.perf_counter()
 
-    def iterate(self, epoch: int, batches):
-        """Yield ``(i, batch)`` like ``enumerate(batches)``, timing each
-        iteration. Pass-through when the sink is disabled."""
+    def iterate(self, epoch: int, batches, start: int = 0):
+        """Yield ``(i, batch)`` like ``enumerate(batches, start)``, timing
+        each iteration. Pass-through when the sink is disabled. ``start``
+        offsets the index for a mid-epoch resume, so logged/emitted batch
+        numbers continue where the interrupted run stopped instead of
+        double-using the indices it already recorded."""
         if not self.log.enabled:
-            yield from enumerate(batches)
+            yield from enumerate(batches, start)
             return
         from mx_rcnn_tpu.obs import compile_track
 
         it = iter(batches)
-        i = 0
+        i = start
         while True:
             t0 = time.perf_counter()
             try:

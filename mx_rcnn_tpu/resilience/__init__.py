@@ -1,0 +1,45 @@
+"""graftguard — fault tolerance for a flaky accelerator relay.
+
+Round 5 (TPU_OUTAGE_r5.log / VERDICT.md) established the failure taxonomy
+this package answers: the backend vanishes for hours (UNAVAILABLE from the
+axon relay), the scheduler preempts multi-hour runs mid-epoch, and a single
+hung compile can eat an entire bench timeout (BENCH_r05 rc=124). graftscope
+(mx_rcnn_tpu/obs) made those failures *visible*; graftguard makes them
+*survivable*:
+
+- ``backend``  — classified backend acquisition: transient errors
+  (UNAVAILABLE — the outage signature) retry with exponential backoff +
+  jitter under a deadline; permanent errors fail fast. Emits
+  ``backend_retry`` / ``backend_up`` graftscope events.
+- ``preempt``  — SIGTERM/SIGINT handlers that request a checkpoint at the
+  next step boundary and exit with ``RESUMABLE_RC`` so a supervisor knows
+  to restart with ``--resume auto``.
+- ``isolate``  — run a callable in a child process under a deadline (the
+  bench's per-config jail: a hung compile forfeits one row, not the sweep).
+- ``chaos``    — deterministic fault injection (raise UNAVAILABLE on the
+  first N probes, SIGTERM at step K, hang one bench config, SIGKILL at a
+  named site) so every guarantee above is exercised by tier-1 CPU tests
+  instead of by the next real outage.
+
+Config: the ``resilience`` section of config.py; runbook: OUTAGES.md.
+"""
+
+from mx_rcnn_tpu.resilience.backend import (
+    BackendUnavailableError,
+    acquire_backend,
+    classify_backend_error,
+)
+from mx_rcnn_tpu.resilience.preempt import (
+    RESUMABLE_RC,
+    PreemptionExit,
+    PreemptionGuard,
+)
+
+__all__ = [
+    "BackendUnavailableError",
+    "acquire_backend",
+    "classify_backend_error",
+    "RESUMABLE_RC",
+    "PreemptionExit",
+    "PreemptionGuard",
+]

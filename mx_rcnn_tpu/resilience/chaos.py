@@ -1,0 +1,139 @@
+"""graftguard fault injection — deterministic, env-carried faults.
+
+Every resilience guarantee in this package is gated by a tier-1 CPU test,
+which means the faults themselves must be injectable on demand: raise the
+round-5 UNAVAILABLE signature on the first N backend probes, deliver
+SIGTERM once the optimizer step count reaches K, hang one named bench
+config, or SIGKILL the process at a named crash-window site. The spec
+travels in the ``MX_RCNN_CHAOS`` environment variable so subprocess tests
+(and operators reproducing an incident) can inject without code changes::
+
+    MX_RCNN_CHAOS="backend_unavailable=3"          # 3 probes fail, then up
+    MX_RCNN_CHAOS="sigterm_at_step=5"              # preempt mid-training
+    MX_RCNN_CHAOS="hang_bench=c4_r101 hang_s=60"   # hang one sweep config
+    MX_RCNN_CHAOS="die_at=checkpoint_finalize"     # SIGKILL mid-save
+
+Pairs are space- or comma-separated ``key=value``; unknown keys raise (a
+typo'd injection silently doing nothing would un-test the gate it was
+written for). With the variable unset every hook is a no-op costing one
+attribute check. stdlib-only — importable without jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+from dataclasses import dataclass
+
+ENV_VAR = "MX_RCNN_CHAOS"
+
+#: Per-process injection state (e.g. how many backend probes have already
+#: been failed) — module-level so repeated ``from_env()`` parses share it.
+_counters: dict = {}
+
+
+def reset():
+    """Clear injection state (tests re-arming a spec within one process)."""
+    _counters.clear()
+
+
+@dataclass(frozen=True)
+class ChaosSpec:
+    """One parsed injection spec. All-defaults == no injection."""
+
+    #: Fail the first N backend probes with the r5 UNAVAILABLE signature.
+    backend_unavailable: int = 0
+    #: Fail every backend probe with a PERMANENT (non-retryable) error.
+    backend_permanent: bool = False
+    #: Deliver SIGTERM (once) when the optimizer step count reaches K.
+    sigterm_at_step: int = 0
+    #: Hang for ``hang_s`` inside the isolated bench child whose config
+    #: name equals ``hang_bench`` (resilience/isolate.py).
+    hang_bench: str = ""
+    hang_s: float = 30.0
+    #: SIGKILL the process at a named site ("checkpoint_finalize" /
+    #: "checkpoint_swap" — the save's crash windows, train/checkpoint.py).
+    die_at: str = ""
+
+    @property
+    def active(self) -> bool:
+        return self != ChaosSpec()
+
+    # -- injection hooks (each a no-op unless its field is armed) ----------
+
+    def maybe_fail_backend(self):
+        """Raise the injected backend failure, if armed. Called by the
+        default acquisition probe BEFORE touching jax (backend.py)."""
+        if self.backend_permanent:
+            raise RuntimeError(
+                "INVALID_ARGUMENT: injected permanent backend failure "
+                "(chaos)")
+        n = self.backend_unavailable
+        if n:
+            done = _counters.get("backend", 0)
+            if done < n:
+                _counters["backend"] = done + 1
+                raise RuntimeError(
+                    "UNAVAILABLE: TPU backend setup/compile error "
+                    f"(Unavailable). [injected outage {done + 1}/{n}, chaos]")
+
+    def maybe_sigterm(self, step: int):
+        """Deliver SIGTERM to this process once ``step`` reaches the armed
+        threshold (tools/train.py calls this after every dispatch)."""
+        if (self.sigterm_at_step and step >= self.sigterm_at_step
+                and not _counters.get("sigterm")):
+            _counters["sigterm"] = 1
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_hang(self, label: str):
+        """Sleep ``hang_s`` when ``label`` matches the armed bench config
+        — the BENCH_r05 hung-compile stand-in (resilience/isolate.py)."""
+        if self.hang_bench and label == self.hang_bench:
+            time.sleep(self.hang_s)
+
+    def maybe_die(self, site: str):
+        """SIGKILL this process at a named site — no atexit, no finally:
+        the honest crash-window probe (train/checkpoint.py)."""
+        if self.die_at and site == self.die_at:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+_FIELDS = {f.name: f for f in dataclasses.fields(ChaosSpec)}
+
+
+def parse(text: str) -> ChaosSpec:
+    """Parse a spec string (see module docstring). Raises on unknown keys
+    and unparseable values — a silently-ignored injection is worse than a
+    loud one."""
+    kw: dict = {}
+    for pair in text.replace(",", " ").split():
+        key, sep, raw = pair.partition("=")
+        if not sep or key not in _FIELDS:
+            raise ValueError(
+                f"bad {ENV_VAR} entry {pair!r}; known keys: "
+                f"{sorted(_FIELDS)}")
+        ftype = _FIELDS[key].type
+        if ftype in ("int", int):
+            kw[key] = int(raw)
+        elif ftype in ("float", float):
+            kw[key] = float(raw)
+        elif ftype in ("bool", bool):
+            v = raw.strip().lower()
+            if v in ("1", "true", "yes", "on"):
+                kw[key] = True
+            elif v in ("0", "false", "no", "off"):
+                kw[key] = False
+            else:
+                raise ValueError(
+                    f"bad {ENV_VAR} boolean {raw!r} for {key}")
+        else:
+            kw[key] = raw
+    return ChaosSpec(**kw)
+
+
+def from_env(environ=os.environ) -> ChaosSpec:
+    """The armed spec for this process (inactive when the var is unset)."""
+    text = environ.get(ENV_VAR, "")
+    return parse(text) if text else ChaosSpec()

@@ -8,7 +8,8 @@ funnel through `fit_detector`.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+import itertools
+from typing import Callable, Dict, List, Optional, Union
 
 import jax
 import numpy as np
@@ -22,8 +23,11 @@ from mx_rcnn_tpu.models.zoo import build_model, forward_train, init_params
 from mx_rcnn_tpu.obs import StallWatchdog, StepTimer, obs_from_config, run_meta_fields
 from mx_rcnn_tpu.obs import compile_track
 from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
+from mx_rcnn_tpu.resilience import PreemptionExit, PreemptionGuard, acquire_backend
+from mx_rcnn_tpu.resilience import chaos
 from mx_rcnn_tpu.train.callback import Speedometer
 from mx_rcnn_tpu.train.checkpoint import (
+    latest_checkpoint,
     latest_epoch,
     load_checkpoint,
     save_checkpoint,
@@ -73,7 +77,7 @@ def fit_detector(
     begin_epoch: int = 0,
     end_epoch: Optional[int] = None,
     frequent: int = 20,
-    resume: bool = False,
+    resume: Union[bool, str] = False,
     pretrained_params=None,
     pretrained_npz: Optional[str] = None,
     mesh_spec: Optional[str] = None,
@@ -92,6 +96,23 @@ def fit_detector(
     fixed_param_patterns extends the frozen set (alternate stages 4/6 freeze
     the shared conv trunk — reference train_alternate.py).
 
+    resume: True resumes from the latest EPOCH-BOUNDARY checkpoint under
+    prefix (the pre-graftguard contract); "auto" also considers graftguard
+    emergency (dispatch-tagged) saves and resumes from the most-advanced
+    point, skipping the already-trained dispatch prefix of the interrupted
+    epoch (the skipped batches are still loaded and discarded — host work
+    only, bounded by one epoch). Resume is bit-exact vs an uninterrupted
+    run: the epoch batch order is a pure function of (seed, epoch)
+    (AnchorLoader.set_epoch) and each dispatch's rng key is derived from
+    its global index (fold_in), not from a run-position-dependent split
+    chain.
+
+    graftguard (cfg.resilience; runbook OUTAGES.md): the backend is
+    acquired through classified retry-with-backoff before the first
+    device touch, and SIGTERM/SIGINT are honored at the next step
+    boundary — emergency checkpoint (resilience.preempt_save), `preempt`
+    event, then PreemptionExit carrying RESUMABLE_RC (75).
+
     With train.async_checkpoint (default, single-process) the epoch-end
     save is enqueued, not durable, when epoch_callback runs — a callback
     that READS the just-saved checkpoint from disk must not assume it has
@@ -105,6 +126,15 @@ def fit_detector(
     from mx_rcnn_tpu.parallel.distributed import is_primary, local_data_shards
 
     end_epoch = end_epoch or cfg.train.end_epoch
+    # graftscope sink FIRST (it touches no jax): backend acquisition below
+    # wants somewhere to emit backend_retry/backend_up events, so an
+    # outage ridden out here leaves a structured record, not a watch log.
+    obs_log = obs_from_config(cfg, default_dir=f"{prefix}.obs")
+    if cfg.resilience.backend_acquire:
+        # Classified retry-with-backoff before the first device touch —
+        # a transient relay outage (the TPU_OUTAGE_r5 signature) delays
+        # the run instead of killing it (resilience/backend.py).
+        acquire_backend(cfg.resilience, elog=obs_log)
     mesh = create_mesh(mesh_spec or cfg.mesh.mesh_shape)
     n_data = mesh.shape["data"]
     # Each process feeds only its own slice of the data axis (multi-host:
@@ -165,23 +195,38 @@ def fit_detector(
     # Resume discovery BEFORE building the optimizer: a restored opt_state
     # carries optax's schedule counter; without one the LR schedule is
     # offset by begin_step instead (never both — that would double-count).
-    resume_epoch = latest_epoch(prefix) if resume else None
+    # resume=True sees epoch-boundary checkpoints only; resume="auto"
+    # (graftguard) also picks up dispatch-tagged emergency saves and
+    # restarts mid-epoch from the most-advanced point.
+    multi = max(1, cfg.train.multi_step_dispatch)
+    resume_epoch = resume_dispatch = None
+    if resume == "auto":
+        found = latest_checkpoint(prefix)
+        if found is not None:
+            resume_epoch, resume_dispatch = found
+    elif resume:
+        resume_epoch = latest_epoch(prefix)
+    skip_dispatch = resume_dispatch or 0
     opt_state = None
     sched_begin = 0
     if resume_epoch is not None:
         begin_epoch = resume_epoch
         tx = build_optimizer(cfg, params, steps_per_epoch)
         params, opt_state = load_checkpoint(
-            prefix, resume_epoch,
+            prefix, resume_epoch, dispatch=resume_dispatch,
             template={"params": params},
             opt_state_template=tx.init(params),
             means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
             num_classes=cfg.dataset.num_classes)
-        logger.info("resumed from %s epoch %d (opt_state %s)", prefix,
-                    resume_epoch, "restored" if opt_state is not None
+        logger.info("resumed from %s epoch %d%s (opt_state %s)", prefix,
+                    resume_epoch,
+                    f" dispatch {resume_dispatch}" if resume_dispatch
+                    else "",
+                    "restored" if opt_state is not None
                     else "reinitialized")
         if opt_state is None:
-            sched_begin = begin_epoch * steps_per_epoch
+            sched_begin = (begin_epoch * steps_per_epoch
+                           + skip_dispatch * multi)
             tx = build_optimizer(cfg, params, steps_per_epoch,
                                  begin_step=sched_begin)
     else:
@@ -192,10 +237,11 @@ def fit_detector(
     state = create_train_state(params, tx)
     if opt_state is not None:
         state = state.replace(opt_state=opt_state)
-    if begin_epoch:
+    if begin_epoch or skip_dispatch:
         state = state.replace(
-            step=jax.numpy.asarray(begin_epoch * steps_per_epoch,
-                                   jax.numpy.int32))
+            step=jax.numpy.asarray(
+                begin_epoch * steps_per_epoch + skip_dispatch * multi,
+                jax.numpy.int32))
 
     param_specs = None
     if cfg.network.tensor_parallel:
@@ -238,21 +284,36 @@ def fit_detector(
                 len(flat_core.table.segments), len(flat_core.table.sizes),
                 {d: n for d, n in flat_core.table.sizes.items()})
 
+    # Flat mode on the CPU backend: donation of the ~100 MB flat buffers
+    # races the CPU client's async execution — the donated input of an
+    # enqueued step can be reclaimed (munmapped, these sizes are direct
+    # mmaps) while still referenced, and the process segfaults at an
+    # unrelated later allocation/read (observed in the tier-1 flat smoke;
+    # crash site wanders: eager fold_in, device_get, logging). Donation
+    # is an HBM-footprint optimization — on the host-memory backend
+    # correctness wins. TPU keeps it.
+    flat_donate = not (flat_core is not None
+                       and jax.default_backend() == "cpu")
     step_fn = make_train_step(model, cfg, mesh=mesh,
+                              donate=flat_donate,
                               forward_fn=forward_fn or forward_train,
                               param_specs=param_specs,
                               flat_core=flat_core)
+    # Per-dispatch rng keys are derived from the dispatch's GLOBAL index
+    # (fold_in), not a run-position-dependent split chain — so a resumed
+    # run consumes exactly the keys the uninterrupted run would have (the
+    # kill→resume bit-exactness gate), at O(1) resume cost.
     rng = jax.random.PRNGKey(seed + 1)
-    multi = max(1, cfg.train.multi_step_dispatch)
+    disp_per_epoch = max(1, steps_per_epoch // multi)
     if multi > 1 and len(loader) % multi:
         logger.warning(
             "multi_step_dispatch=%d drops %d trailing batch(es) per epoch "
             "(loader yields %d)", multi, len(loader) % multi, len(loader))
     batch_size = cfg.train.batch_images * accum * n_data * multi
 
-    # graftscope telemetry (mx_rcnn_tpu/obs): a no-op sink unless
-    # cfg.obs.enabled — the disabled path adds nothing to the hot loop.
-    obs_log = obs_from_config(cfg, default_dir=f"{prefix}.obs")
+    # graftscope telemetry (mx_rcnn_tpu/obs): the sink was opened at the
+    # top of this function (backend acquisition emits through it); a
+    # no-op unless cfg.obs.enabled — nothing added to the hot loop.
     watchdog = None
     if obs_log.enabled:
         obs_log.emit("run_meta", **run_meta_fields(
@@ -292,17 +353,73 @@ def fit_detector(
 
             writer = CheckpointWriter()
 
+    # graftguard preemption (resilience/preempt.py): the handlers only
+    # RECORD the signal; the loop below honors it at step boundaries.
+    # install() is a no-op off the main thread (the guard stays inert).
+    guard = None
+    if cfg.resilience.preempt_handlers:
+        guard = PreemptionGuard()
+        guard.install()
+    chaos_spec = chaos.from_env()
+
+    def _honor_preemption(at_epoch: int, at_dispatch: Optional[int],
+                          need_save: bool = True):
+        """Orderly preemption exit: emergency checkpoint (sync — it must
+        be durable before the process dies), `preempt` event, then
+        PreemptionExit carrying the resumable rc. at_dispatch=None marks
+        an epoch boundary (at_epoch epochs complete)."""
+        saved = None
+        if need_save and cfg.resilience.preempt_save and is_primary():
+            if flat_core is not None:
+                save_params, save_opt = flat_core.tree_state(state)
+            else:
+                save_params, save_opt = state.params, state.opt_state
+            saved = save_checkpoint(
+                prefix, at_epoch, save_params, save_opt,
+                means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+                num_classes=cfg.dataset.num_classes, dispatch=at_dispatch)
+        if obs_log.enabled:
+            obs_log.emit("preempt", signal=guard.signum,
+                         step=(at_epoch * steps_per_epoch
+                               + (at_dispatch or 0) * multi),
+                         saved=saved)
+        logger.warning("preempted (signal %s) at epoch %d dispatch %s — "
+                       "exiting rc %d; restart with --resume auto",
+                       guard.signum, at_epoch, at_dispatch,
+                       PreemptionExit().code)
+        raise PreemptionExit(guard.signum)
+
     try:
         for epoch in range(begin_epoch, end_epoch):
+            if hasattr(loader, "set_epoch"):
+                # epoch order = f(seed, epoch): a resumed epoch replays
+                # exactly the order the uninterrupted run saw.
+                loader.set_epoch(epoch)
+            skip = skip_dispatch if epoch == begin_epoch else 0
+            batches = _dispatch_batches(loader, multi)
+            if skip:
+                logger.info("mid-epoch resume: skipping %d already-"
+                            "trained dispatch(es) of epoch %d", skip, epoch)
+                batches = itertools.islice(batches, skip, None)
             bag = MetricBag()
-            for i, batch in timer.iterate(
-                    epoch, _dispatch_batches(loader, multi)):
-                rng, k = jax.random.split(rng)
+            # start=skip keeps i the TRUE epoch-local dispatch index on a
+            # mid-epoch resume — telemetry/log batch numbers continue
+            # where the interrupted run stopped rather than restarting
+            # at 0 over indices it already recorded.
+            for i, batch in timer.iterate(epoch, batches, start=skip):
+                k = jax.random.fold_in(  # graftlint: disable=prng-key-reuse — the root is folded with a DISTINCT global dispatch index each iteration (the resumable-key derivation; see the rng comment above)
+                    rng, epoch * disp_per_epoch + i)
                 state, metrics = step_fn(
                     state, shard_batch(batch, mesh, stacked=multi > 1), k)
                 timer.dispatched()
                 bag.update(metrics)
                 speedometer(epoch, i, bag)
+                done = i + 1  # dispatches complete in this epoch
+                if chaos_spec.active:
+                    chaos_spec.maybe_sigterm(
+                        epoch * steps_per_epoch + done * multi)
+                if guard is not None and guard.requested:
+                    _honor_preemption(epoch, done)
             logger.info("Epoch[%d] done. %s", epoch, bag.format())
             if obs_log.enabled:
                 # bag.format() above already drained the pending device
@@ -317,6 +434,7 @@ def fit_detector(
             # outlive the epoch (data/loader.py).
             if hasattr(loader, "close"):
                 loader.close()
+            epoch_saved = False
             if is_primary() and ((epoch + 1) % max(1, checkpoint_period) == 0
                                  or epoch + 1 == end_epoch):
                 if flat_core is not None:
@@ -329,20 +447,30 @@ def fit_detector(
                 save(prefix, epoch + 1, save_params, save_opt,
                      means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
                      num_classes=cfg.dataset.num_classes)
+                epoch_saved = True
                 if obs_log.enabled:
                     obs_log.emit("checkpoint", epoch=epoch + 1,
                                  prefix=prefix,
                                  durable=writer is None)
             if epoch_callback:
                 epoch_callback(epoch, state, bag)
+            if guard is not None and guard.requested:
+                # Signal landed during epoch-end work: exit at the
+                # boundary. The save just enqueued (if any) goes durable
+                # in the finally below (writer.close publishes it);
+                # otherwise (checkpoint_period skipped this epoch) write
+                # a boundary checkpoint now so nothing is lost.
+                _honor_preemption(epoch + 1, None, need_save=not epoch_saved)
     except BaseException as exc:  # graftlint: disable=broad-except — crash telemetry, re-raised below
-        if obs_log.enabled:
+        if obs_log.enabled and not isinstance(exc, PreemptionExit):
             import traceback
 
             obs_log.emit("crash", error=repr(exc),
                          traceback=traceback.format_exc())
         raise
     finally:
+        if guard is not None:
+            guard.uninstall()
         if watchdog is not None:
             watchdog.stop()
         if obs_log.enabled and cfg.obs.track_compiles:
@@ -352,7 +480,10 @@ def fit_detector(
             writer.close()  # the last save must be durable before return
         if hasattr(loader, "close"):
             loader.close()  # crash paths must not leak worker threads
-    # In flat mode, FlatTrainState.params is already a host-owned copy
-    # tree (never views of the donated device buffers); device_get is
-    # then a pass-through.
-    return jax.device_get(state.params)
+    # Host-OWNED copies, not views: on the CPU backend device_get can
+    # return zero-copy numpy views of runtime buffers, and callers hold
+    # the returned tree across later jax work in the same process (the
+    # kill->resume parity gate compares trees from THREE runs) — a
+    # reused buffer would silently corrupt the caller's copy. One
+    # end-of-training copy is noise next to an epoch.
+    return jax.tree_util.tree_map(np.array, jax.device_get(state.params))

@@ -17,7 +17,9 @@ inference via load_checkpoint round-trips to identical predictions.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional, Sequence
+import re
+import shutil
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +27,21 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.resilience import chaos
+
+#: Checkpoint directory names. Epoch-boundary saves keep the reference's
+#: zero-padded epoch number ("0007" = 7 epochs complete); graftguard
+#: emergency saves are dispatch-tagged ("0003d00012" = mid-epoch 3, 12
+#: dispatches complete — resilience/preempt.py). Anything else under the
+#: prefix (in-flight "*.tmp-*" dirs, orbax droppings) is never a resume
+#: candidate.
+_CKPT_NAME_RE = re.compile(r"^(\d+)(?:d(\d+))?$")
+
+
+def checkpoint_name(epoch: int, dispatch: Optional[int] = None) -> str:
+    if dispatch is None:
+        return f"{epoch:04d}"
+    return f"{epoch:04d}d{dispatch:05d}"
 
 
 def _map_bbox_pred(params, fn_kernel, fn_bias):
@@ -67,11 +84,13 @@ def renormalize_bbox_params(params, means: Sequence[float], stds: Sequence[float
     )
 
 
-def _prepare_save(prefix, epoch, params, opt_state, means, stds, num_classes):
+def _prepare_save(prefix, epoch, params, opt_state, means, stds, num_classes,
+                  dispatch=None):
     """The ONE encoding of the on-disk form (shared by sync and async
     paths): host (numpy) arrays — so checkpoints restore on any device
     topology, TP/PP-sharded or not — with bbox_pred folded to raw deltas."""
-    path = os.path.abspath(os.path.join(prefix, f"{epoch:04d}"))
+    path = os.path.abspath(os.path.join(prefix,
+                                        checkpoint_name(epoch, dispatch)))
     to_save = {"params": jax.device_get(params)}
     if num_classes is not None:
         to_save["params"] = unnormalize_bbox_params(
@@ -81,18 +100,86 @@ def _prepare_save(prefix, epoch, params, opt_state, means, stds, num_classes):
     return path, to_save
 
 
+def _finalize(tmp: str, final: str):
+    """Atomically publish a fully-written checkpoint dir: a SIGKILL any
+    time before the rename leaves only a ``*.tmp-*`` dir, which no resume
+    path ever considers (latest_epoch/latest_checkpoint match the final
+    name grammar only) — a truncated checkpoint can never be resumed
+    from. The rename is same-directory, so same-filesystem.
+
+    A re-save of an existing dir (force=True semantics) must not destroy
+    the previous good checkpoint before the new one is published: the old
+    dir is set ASIDE by rename (``<final>.old`` — outside the resume name
+    grammar, deleted only after the new dir is in place), so the
+    no-checkpoint window is two renames, not an rmtree. A kill between
+    them leaves ``.old`` as a manually recoverable copy."""
+    c = chaos.from_env()
+    # chaos site "checkpoint_finalize": the crash-window test SIGKILLs
+    # here — after the full write, before publication (test_resilience).
+    c.maybe_die("checkpoint_finalize")
+    old = final + ".old"
+    if os.path.isdir(final):
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.replace(final, old)
+        # chaos site "checkpoint_swap": previous checkpoint set aside,
+        # new one not yet published — the narrowest crash window.
+        c.maybe_die("checkpoint_swap")
+    os.replace(tmp, final)
+    if os.path.isdir(old):  # ours, or an orphan of a crashed predecessor
+        shutil.rmtree(old)
+
+
+def _tmp_path(final: str) -> str:
+    return f"{final}.tmp-{os.getpid()}"
+
+
+_TMP_SUFFIX_RE = re.compile(r"\.tmp-(\d+)$")
+
+
+def _sweep_stale_tmps(prefix: str):
+    """Remove ``*.tmp-<pid>`` dirs abandoned by DEAD processes — every
+    kill inside the save window (the scenario graftguard engineers for)
+    leaves one at full model size, and no other path deletes them.
+    Live pids are skipped (checkpointing is single-writer per prefix,
+    but don't yank an in-flight write on a stale assumption); crashed
+    ``.old`` asides are kept — they are the recovery copy."""
+    if not os.path.isdir(prefix):
+        return
+    for name in os.listdir(prefix):
+        m = _TMP_SUFFIX_RE.search(name)
+        if not m or int(m.group(1)) == os.getpid():
+            continue
+        try:
+            os.kill(int(m.group(1)), 0)
+        except ProcessLookupError:
+            logger.warning("removing stale checkpoint tmp %s (dead pid)",
+                           name)
+            shutil.rmtree(os.path.join(prefix, name), ignore_errors=True)
+        except PermissionError:
+            pass  # pid exists (not ours): in-flight, leave it
+
+
 def save_checkpoint(prefix: str, epoch: int, params, opt_state=None, *,
                     means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2),
-                    num_classes: Optional[int] = None):
+                    num_classes: Optional[int] = None,
+                    dispatch: Optional[int] = None):
     """Save epoch checkpoint at <prefix>/<epoch>/ (raw-delta form).
 
     opt_state is saved alongside when given (the reference cannot resume
     optimizer momentum — we can; --resume uses it when present).
+    ``dispatch`` tags a graftguard mid-epoch emergency save (see
+    checkpoint_name); the write lands in a ``*.tmp-*`` dir and is
+    published by one atomic rename, so a kill mid-save leaves no
+    resumable-looking partial state.
     """
     path, to_save = _prepare_save(prefix, epoch, params, opt_state,
-                                  means, stds, num_classes)
+                                  means, stds, num_classes, dispatch)
+    _sweep_stale_tmps(prefix)
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, to_save, force=True)
+    tmp = _tmp_path(path)
+    ckptr.save(tmp, to_save, force=True)
+    _finalize(tmp, path)
     logger.info("Saved checkpoint to %s", path)
     return path
 
@@ -114,41 +201,64 @@ class CheckpointWriter:
 
     def __init__(self):
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        # (tmp, final) of the in-flight save; published (renamed) only
+        # after orbax confirms the write finished — the same atomic
+        # crash-window guarantee as the sync path, deferred.
+        self._pending: Optional[Tuple[str, str]] = None
+
+    def _publish_pending(self):
+        if self._pending is not None:
+            tmp, final = self._pending
+            self._pending = None
+            _finalize(tmp, final)
+            logger.info("Checkpoint %s durable", final)
 
     def save(self, prefix: str, epoch: int, params, opt_state=None, *,
              means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2),
-             num_classes: Optional[int] = None):
+             num_classes: Optional[int] = None,
+             dispatch: Optional[int] = None):
         """Non-blocking analog of `save_checkpoint` — _prepare_save gives
         the identical on-disk form (host numpy; restores on any device
         topology); only the write is backgrounded. NOT durable on return:
         readers of the checkpoint (e.g. an eval driver watching the
-        prefix) see it after the NEXT save or close()."""
+        prefix) see it after the NEXT save or close() — the final dir
+        name only appears at that point (the write itself targets a
+        ``*.tmp-*`` dir, so a kill mid-write leaves nothing resumable)."""
         self._ckptr.wait_until_finished()
+        self._publish_pending()
         path, to_save = _prepare_save(prefix, epoch, params, opt_state,
-                                      means, stds, num_classes)
-        self._ckptr.save(path, to_save, force=True)
+                                      means, stds, num_classes, dispatch)
+        _sweep_stale_tmps(prefix)
+        tmp = _tmp_path(path)
+        self._ckptr.save(tmp, to_save, force=True)
+        self._pending = (tmp, path)
         logger.info("Saving checkpoint to %s (async)", path)
         return path
 
     def close(self):
         """Release the background machinery (waits for the in-flight
-        save first — orbax close() is wait + teardown)."""
+        save first — orbax close() is wait + teardown — then publishes
+        it)."""
         self._ckptr.close()
+        self._publish_pending()
 
 
 def load_checkpoint(prefix: str, epoch: int, *, template=None,
                     opt_state_template=None,
                     means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2),
-                    num_classes: Optional[int] = None):
+                    num_classes: Optional[int] = None,
+                    dispatch: Optional[int] = None):
     """Load epoch checkpoint; returns (params, opt_state_or_None).
 
     Re-normalizes bbox_pred (reference: load_param + re-normalization under
     --resume in train_end2end.py). opt_state_template is REQUIRED to get a
     usable opt_state back: orbax restores untyped pytrees (dicts/lists), and
     optax states are namedtuples — restore against tx.init(params) or the
-    result is train-step poison.
+    result is train-step poison. ``dispatch`` selects a graftguard
+    mid-epoch emergency save (checkpoint_name).
     """
-    path = os.path.abspath(os.path.join(prefix, f"{epoch:04d}"))
+    path = os.path.abspath(os.path.join(prefix,
+                                        checkpoint_name(epoch, dispatch)))
     ckptr = ocp.PyTreeCheckpointer()
     item = None
     if template is not None:
@@ -220,9 +330,36 @@ def _has_opt_state(path: str) -> bool:
 
 
 def latest_epoch(prefix: str) -> Optional[int]:
-    """Highest saved epoch under prefix, or None — restart-from-latest support
-    (failure recovery; the reference has none, SURVEY.md §6)."""
+    """Highest saved EPOCH-BOUNDARY checkpoint under prefix, or None —
+    restart-from-latest support (failure recovery; the reference has
+    none, SURVEY.md §6). Ignores graftguard emergency (dispatch-tagged)
+    saves and in-flight ``*.tmp-*`` dirs; ``--resume auto`` goes through
+    latest_checkpoint to pick those up."""
     if not os.path.isdir(prefix):
         return None
     epochs = [int(d) for d in os.listdir(prefix) if d.isdigit()]
     return max(epochs) if epochs else None
+
+
+def latest_checkpoint(prefix: str) -> Optional[Tuple[int, Optional[int]]]:
+    """The most-advanced resume point under prefix: ``(epoch, None)`` for
+    an epoch-boundary checkpoint ("epoch" epochs complete) or
+    ``(epoch, dispatch)`` for a graftguard emergency save (mid-epoch
+    ``epoch``, ``dispatch`` dispatches complete). Progress orders as the
+    tuple: epoch save N ≡ (N, 0) sits between (N-1, d) emergencies and
+    any (N, d>0) emergency. Unfinished ``*.tmp-*`` writes never match the
+    name grammar, so a kill mid-save can never be resumed from."""
+    if not os.path.isdir(prefix):
+        return None
+    best = None
+    for d in os.listdir(prefix):
+        m = _CKPT_NAME_RE.match(d)
+        if not m:
+            continue
+        epoch, dispatch = int(m.group(1)), m.group(2)
+        key = (epoch, int(dispatch) if dispatch else 0)
+        if best is None or key > best:
+            best = key
+    if best is None:
+        return None
+    return best[0], (best[1] or None)

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# graftguard chaos gate — the fault-injection subset of tier-1 on CPU:
+# injected UNAVAILABLE outages, SIGTERM preemption + kill->resume parity,
+# hung-bench deadline isolation, and the checkpoint crash window
+# (tests/test_resilience.py; runbook OUTAGES.md). Every failure mode the
+# round-5 outage demonstrated, exercised on demand instead of by the next
+# real outage. Same invocation locally and in any future CI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS=cpu exec python -m pytest -m chaos "$@"

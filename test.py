@@ -59,6 +59,20 @@ def main():
     cfg = generate_config(args.network, args.dataset, **overrides)
     image_set = args.image_set or cfg.dataset.test_image_set
 
+    # graftscope (--set obs.enabled=true [--set obs.dir=...]): the eval
+    # run gets a run_meta record and pred_eval emits the `eval` result.
+    # Opened before the first device touch so graftguard backend
+    # acquisition below has somewhere to emit backend_retry events.
+    from mx_rcnn_tpu.obs import obs_from_config, run_meta_fields
+
+    obs_log = obs_from_config(cfg, default_dir=f"{args.prefix}.obs")
+    if cfg.resilience.backend_acquire:
+        # graftguard: ride out a transient relay outage instead of dying
+        # on first touch (resilience/backend.py; runbook OUTAGES.md).
+        from mx_rcnn_tpu.resilience import acquire_backend
+
+        acquire_backend(cfg.resilience, elog=obs_log)
+
     ds = dataset_from_config(cfg.dataset, image_set)
     roidb = ds.gt_roidb()
     model = build_model(cfg)
@@ -69,11 +83,6 @@ def main():
         num_classes=cfg.dataset.num_classes)
     predictor = Predictor(model, params, cfg)
     loader = TestLoader(roidb, cfg, batch_size=args.batch_size)
-    # graftscope (--set obs.enabled=true [--set obs.dir=...]): the eval
-    # run gets a run_meta record and pred_eval emits the `eval` result.
-    from mx_rcnn_tpu.obs import obs_from_config, run_meta_fields
-
-    obs_log = obs_from_config(cfg, default_dir=f"{args.prefix}.obs")
     if obs_log.enabled:
         obs_log.emit("run_meta", **run_meta_fields(
             cfg, tool="test", prefix=args.prefix, epoch=args.epoch,

@@ -1,0 +1,148 @@
+"""graftguard test driver — subprocess entry + picklable sweep runners.
+
+tests/test_resilience.py uses this module two ways:
+
+- as a SUBPROCESS entry (``python tests/_resilience_driver.py --fit ...``)
+  for the gates that need a real process boundary: the preemption exit
+  code (SIGTERM → rc 75 is a process-level contract) and the
+  checkpoint crash window (``--crash-save`` + chaos
+  ``die_at=checkpoint_finalize`` SIGKILLs mid-save — nothing in-process
+  survives that by design);
+- as an IMPORT for the in-process parity gates (``tiny_config`` /
+  ``run_fit``) and for the module-level functions the deadline-isolation
+  tests ship to spawn children (``sweep_runner`` and friends — a spawn
+  child unpickles them by qualified name, so they must live in an
+  importable module, and this module's top-level imports stay
+  stdlib-only to keep child startup off the jax import path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+# Script execution puts tests/ (not the repo root) on sys.path.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+# ---------------------------------------------------------------------------
+# picklable runners for resilience/isolate.py spawn children
+# ---------------------------------------------------------------------------
+
+def sweep_runner(label):
+    """A well-behaved bench runner: one structured row, instantly."""
+    return {"img_s_per_chip": 1.0, "which": label}
+
+
+def sleepy_runner(label):
+    """Stands in for the BENCH_r05 hung compile (without chaos wiring)."""
+    time.sleep(60.0)
+    return {"img_s_per_chip": 0.0, "which": label}
+
+
+def error_runner(label):
+    raise RuntimeError(f"relay dropped mid-measure ({label})")
+
+
+# ---------------------------------------------------------------------------
+# the tiny fit (in-process helper + --fit subprocess mode)
+# ---------------------------------------------------------------------------
+
+def tiny_config(flat: bool = False, obs_dir: str = ""):
+    """The 64^2 f32 micro-config of tests/test_flatcore.py, plus
+    power-of-two bbox stds: the kill->resume parity gates assert BIT
+    exactness, and an emergency save round-trips bbox_pred through
+    unnormalize (kernel*std) + renormalize (kernel/std) — exact for
+    powers of two, not for the default 0.1/0.2."""
+    from dataclasses import replace
+
+    from mx_rcnn_tpu.config import generate_config
+
+    over = {
+        "train.rpn_pre_nms_top_n": 128,
+        "train.rpn_post_nms_top_n": 32,
+        "train.batch_rois": 16,
+        "train.max_gt_boxes": 4,
+        "train.batch_images": 1,
+        "train.flip": False,
+        "network.anchor_scales": (2, 4),
+        "image.pad_shape": (64, 64),
+        "image.scales": ((64, 64),),
+    }
+    if obs_dir:
+        over["obs.enabled"] = True
+        over["obs.dir"] = obs_dir
+    cfg = generate_config("resnet50", "synthetic", **over)
+    return cfg.with_updates(
+        network=replace(cfg.network, compute_dtype="float32"),
+        train=replace(cfg.train, flat_params=flat,
+                      bbox_stds=(0.5, 0.5, 0.25, 0.25)))
+
+
+def run_fit(prefix: str, end_epoch: int = 2, resume=False,
+            flat: bool = False, obs_dir: str = ""):
+    """3 images x 64^2, seed 0, mesh "1" — returns the final host params.
+    Deterministic end to end, so an interrupted+resumed run must match an
+    uninterrupted one bit for bit."""
+    from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.tools.train import fit_detector
+
+    ds = SyntheticDataset("train", num_images=3, image_size=64,
+                          max_objects=1, min_size_frac=3, max_size_frac=2)
+    return fit_detector(tiny_config(flat, obs_dir), ds.gt_roidb(),
+                        prefix=prefix, end_epoch=end_epoch, frequent=1000,
+                        seed=0, mesh_spec="1", resume=resume)
+
+
+def _crash_save(prefix: str, scale: float = 1.0):
+    """One sync checkpoint save of a known tiny tree (``scale`` makes
+    successive saves distinguishable). With chaos ``die_at=
+    checkpoint_finalize`` / ``checkpoint_swap`` armed the process
+    SIGKILLs inside that crash window; unarmed it publishes."""
+    import numpy as np
+
+    from mx_rcnn_tpu.train.checkpoint import save_checkpoint
+
+    save_checkpoint(prefix, 1,
+                    {"w": scale * np.arange(6, dtype=np.float32).reshape(2, 3)})
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--fit", metavar="PREFIX",
+                   help="run the tiny training run under PREFIX")
+    p.add_argument("--end-epoch", type=int, default=2)
+    p.add_argument("--resume", nargs="?", const=True, default=False,
+                   choices=[True, "auto"], metavar="auto")
+    p.add_argument("--flat", action="store_true",
+                   help="train.flat_params=true mode")
+    p.add_argument("--obs-dir", default="")
+    p.add_argument("--crash-save", metavar="PREFIX",
+                   help="one sync checkpoint save (the crash-window probe)")
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="scale factor on the --crash-save tree")
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from mx_rcnn_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # share tests/.jax_cache with the suite
+
+    if args.crash_save:
+        _crash_save(args.crash_save, scale=args.scale)
+        return 0
+    if args.fit:
+        run_fit(args.fit, end_epoch=args.end_epoch, resume=args.resume,
+                flat=args.flat, obs_dir=args.obs_dir)
+        return 0
+    p.error("one of --fit / --crash-save is required")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

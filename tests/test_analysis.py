@@ -750,3 +750,122 @@ def test_flat_state_near_miss_template_names():
             return opt_state_template["params"]
     """)
     assert "flat-state-access" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# unbounded-retry
+# ---------------------------------------------------------------------------
+
+def test_retry_flags_the_r5_watcher_shape():
+    """The literal TPU_OUTAGE_r5.log anti-pattern: while True, swallow,
+    sleep a constant — no deadline, no backoff."""
+    findings = lint("""
+        import time
+
+        import jax
+
+        def wait_for_tpu():
+            while True:
+                try:
+                    return jax.devices()
+                except RuntimeError:
+                    time.sleep(540)  # fixed 9-minute cadence
+    """)
+    assert sum(f.rule == "unbounded-retry" for f in findings) == 1
+
+
+def test_retry_flags_itertools_count_disguise():
+    findings = lint("""
+        import itertools
+        import time
+
+        def probe(connect):
+            for attempt in itertools.count():
+                try:
+                    return connect()
+                except ConnectionError:
+                    time.sleep(5)
+    """)
+    assert "unbounded-retry" in rules_of(findings)
+
+
+def test_retry_flags_constant_arithmetic_cadence():
+    """sleep(9 * 60) is the same fixed cadence as sleep(540) — constant
+    arithmetic must not read as per-iteration computation (backoff)."""
+    findings = lint("""
+        import time
+
+        import jax
+
+        def wait_for_tpu():
+            while True:
+                try:
+                    return jax.devices()
+                except RuntimeError:
+                    time.sleep(9 * 60)
+    """)
+    assert sum(f.rule == "unbounded-retry" for f in findings) == 1
+
+
+def test_retry_flags_fixed_cadence_from_untouched_name():
+    """sleep(PAUSE) where the loop never reassigns PAUSE is still a
+    fixed cadence, not backoff."""
+    findings = lint("""
+        import time
+
+        PAUSE = 9 * 60
+
+        def watch(probe):
+            while True:
+                try:
+                    return probe()
+                except RuntimeError:
+                    time.sleep(PAUSE)
+    """)
+    assert "unbounded-retry" in rules_of(findings)
+
+
+def test_retry_near_miss_bounded_for_and_backoff_and_deadline():
+    findings = lint("""
+        import time
+
+        def bounded(probe):
+            for _ in range(5):            # finite attempts
+                try:
+                    return probe()
+                except RuntimeError:
+                    time.sleep(1.0)
+
+        def backoff(probe):
+            delay = 1.0
+            while True:
+                try:
+                    return probe()
+                except RuntimeError:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 300.0)   # backoff evidence
+
+        def deadlined(probe, deadline_s):
+            start = time.monotonic()
+            while True:
+                try:
+                    return probe()
+                except RuntimeError:
+                    if time.monotonic() - start > deadline_s:
+                        raise
+                    time.sleep(2.0)
+    """)
+    assert "unbounded-retry" not in rules_of(findings)
+
+
+def test_retry_near_miss_poll_loop_without_handler():
+    """A sleep-poll loop with no except handler is a wait loop, not a
+    retry loop — out of scope."""
+    findings = lint("""
+        import time
+
+        def wait_until(ready):
+            while not ready():
+                time.sleep(0.5)
+    """)
+    assert "unbounded-retry" not in rules_of(findings)

@@ -34,7 +34,13 @@ def parse_args():
     p.add_argument("--work_load_list", default=None, help="no-op alias")
     p.add_argument("--no_flip", action="store_true")
     p.add_argument("--no_shuffle", action="store_true")
-    p.add_argument("--resume", action="store_true")
+    p.add_argument("--resume", nargs="?", const=True, default=False,
+                   choices=[True, "auto"], metavar="auto",
+                   help="bare --resume: restart from the latest epoch-"
+                        "boundary checkpoint under --prefix; --resume auto "
+                        "also picks up graftguard emergency (mid-epoch) "
+                        "saves — the restart contract after a rc=75 "
+                        "preemption exit (OUTAGES.md)")
     p.add_argument("--pretrained", default=None,
                    help="init weights: a .npz ImageNet manifest (see "
                         "utils/pretrained.py; convert torch checkpoints "
