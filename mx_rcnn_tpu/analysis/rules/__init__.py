@@ -6,6 +6,7 @@ adding a rule = adding a module here and appending it to ALL_RULES.
 
 from mx_rcnn_tpu.analysis.rules import (
     cfg_contract,
+    chaos_site,
     donation,
     excepts,
     flat_state,
@@ -26,6 +27,7 @@ ALL_RULES = (
     obs_schema,
     flat_state,
     retry,
+    chaos_site,
 )
 
 __all__ = ["ALL_RULES"]

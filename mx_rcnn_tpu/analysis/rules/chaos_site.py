@@ -1,0 +1,126 @@
+"""chaos-site-name: chaos injection sites must be known, literal names.
+
+Every fault-injection point (``resilience/chaos.py``) is addressed by a
+site name — ``chaos.site("checkpoint_finalize")``,
+``chaos_spec.fire("train_dispatch", ...)``, ``spec.maybe_die(...)``. A
+typo'd site string never fires: the armed injection silently does
+nothing, the chaos test that depends on it passes vacuously, and a
+"tested" resilience guarantee goes untested (the exact failure mode the
+chaos parser's unknown-key check guards on the OTHER side of the
+contract). Like obs-event-schema, the registered set is recovered from
+``chaos.py::SITES`` in source — the linter never imports the package;
+runtime validation exists too, but only on lines that run.
+
+Recognized injectors (syntactic): a call to ``site``/``fire``/
+``maybe_die`` whose receiver's final name segment is ``chaos``,
+``chaos_spec``, ``spec``, or ``c``, or ends in ``_chaos``/``_spec`` —
+the repo's naming convention for chaos bindings — plus the bare
+``site(...)`` of a ``from ... import site``-free module (not used here,
+but cheap to cover via the dotted form). Non-literal site names are
+flagged too: a computed site defeats both this rule and reviewability.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, Optional, Set
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import dotted_name
+
+NAME = "chaos-site-name"
+RATIONALE = ("a typo'd chaos site string silently never fires and the "
+             "guarantee it was meant to test goes untested; resolve site "
+             "literals against resilience/chaos.py::SITES at lint time")
+
+#: injector method names covered by this rule (maybe_hang takes a bench
+#: CONFIG label, not a site — out of scope; maybe_sigterm takes a step).
+_INJECTOR_ATTRS = frozenset({"site", "fire", "maybe_die"})
+
+#: receiver name segments treated as chaos/ChaosSpec bindings
+_RECEIVER_NAMES = frozenset({"chaos", "chaos_spec", "spec", "c"})
+_RECEIVER_SUFFIXES = ("_chaos", "_spec")
+
+_SITES_CACHE: dict = {}
+
+
+def _chaos_path() -> str:
+    # analysis/rules/chaos_site.py -> analysis/ -> mx_rcnn_tpu/resilience/
+    return os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "..", "resilience", "chaos.py"))
+
+
+def _sites() -> Optional[Set[str]]:
+    """SITES parsed from resilience/chaos.py's AST (cached)."""
+    path = _chaos_path()
+    if path in _SITES_CACHE:
+        return _SITES_CACHE[path]
+    sites: Optional[Set[str]] = None
+    if os.path.isfile(path):
+        with open(path, "r", encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                tree = None
+        if tree is not None:
+            for node in tree.body:
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == "SITES"):
+                    continue
+                value = node.value
+                # frozenset({...}) / set literal / tuple / list
+                if (isinstance(value, ast.Call)
+                        and dotted_name(value.func) in ("frozenset", "set")
+                        and value.args):
+                    value = value.args[0]
+                if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+                    sites = {elt.value for elt in value.elts
+                             if isinstance(elt, ast.Constant)
+                             and isinstance(elt.value, str)}
+    _SITES_CACHE[path] = sites
+    return sites
+
+
+def _is_chaos_receiver(receiver: Optional[str]) -> bool:
+    if not receiver:
+        return False
+    base = receiver.rsplit(".", 1)[-1]
+    return base in _RECEIVER_NAMES or base.endswith(_RECEIVER_SUFFIXES)
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    sites = _sites()
+    if not sites:
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _INJECTOR_ATTRS):
+            continue
+        if not _is_chaos_receiver(dotted_name(node.func.value)):
+            continue
+        if not node.args:
+            yield ctx.finding(
+                NAME, node,
+                f"chaos {node.func.attr}() needs the site name as its "
+                "first positional argument")
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            yield ctx.finding(
+                NAME, node,
+                "chaos site name must be a string LITERAL so the "
+                "registered-sites set is checkable at lint time (got "
+                f"`{ast.unparse(first)}`)")
+            continue
+        if first.value not in sites:
+            yield ctx.finding(
+                NAME, node,
+                f"unregistered chaos site {first.value!r}; the registered "
+                f"set (resilience/chaos.py::SITES) is "
+                f"{tuple(sorted(sites))}")

@@ -380,6 +380,24 @@ class ResilienceConfig:
     # dispatch-tagged dir under the prefix; picked up by --resume auto).
     # False: exit resumable-rc without saving (epoch checkpoints only).
     preempt_save: bool = True
+    # graftheal (resilience/heal.py): a step-time transient backend loss
+    # (the TPU_OUTAGE_r5 signature, mid-run) is healed IN-PROCESS —
+    # emergency capture of the last known-good host state, backend
+    # teardown + re-acquisition under backend_deadline_s, resume from
+    # the captured state. If the backend returns with fewer devices the
+    # mesh is re-cut (model axis kept, data axis shrunk; global batch
+    # invariant). False = the pre-heal behavior: the error propagates.
+    heal: bool = True
+    # Give up (re-raise) after this many consecutive heals with no
+    # completed dispatch in between — a fault that recurs instantly is
+    # not an outage.
+    heal_consecutive_max: int = 3
+    # Refresh the host-side fallback snapshot every N completed
+    # dispatches (one device_get sync each). 0 = live capture only:
+    # fine when the post-loss state is readable (no donation, or chaos
+    # injection); on real hardware with donated buffers the snapshot is
+    # what bounds the deterministic replay after a mid-step loss.
+    heal_snapshot_dispatches: int = 200
 
 
 @dataclass(frozen=True)

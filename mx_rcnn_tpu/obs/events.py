@@ -55,6 +55,9 @@ EVENT_TYPES = (
     "backend_up",  # graftguard: backend acquired (attempts, waited_s)
     "preempt",     # SIGTERM/SIGINT honored at a step boundary; emergency
                    # checkpoint state in `saved` (resilience/preempt.py)
+    "heal",        # graftheal: step-time backend loss recovered in-process
+                   # (capture mode, downtime_s, devices before/after —
+                   # resilience/heal.py)
 )
 
 #: Buffered kinds — everything else flushes to disk immediately, so the

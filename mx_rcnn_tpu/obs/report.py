@@ -130,6 +130,22 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "preempts": [{"signal": e.get("signal"), "step": e.get("step"),
                       "saved": e.get("saved")}
                      for e in by_type.get("preempt", ())],
+        # graftheal: in-run recoveries — how often the backend was lost
+        # mid-run, how long the run was down for it, and any elastic
+        # shrink transitions (device count before -> after).
+        "heals": {
+            "count": len(by_type.get("heal", ())),
+            "downtime_s": round(sum(e.get("downtime_s", 0.0)
+                                    for e in by_type.get("heal", ())), 3),
+            "shrinks": [f"{e.get('devices_before')}->"
+                        f"{e.get('devices_after')}"
+                        for e in by_type.get("heal", ())
+                        if e.get("devices_before") is not None
+                        and e.get("devices_after") is not None
+                        and e["devices_before"] != e["devices_after"]],
+            "last_error": (by_type["heal"][-1].get("error")
+                           if by_type.get("heal") else None),
+        },
         "crash": ({"error": crash.get("error"), "step": crash.get("step")}
                   if crash else None),
     }
@@ -149,6 +165,7 @@ def bench_blob(summary: Dict[str, Any]) -> Dict[str, Any]:
         "data_wait_fraction": summary["data_wait"]["fraction"],
         "stall_count": summary["stalls"],
         "backend_retries": summary["backend"]["retries"],
+        "heal_count": summary["heals"]["count"],
         "detail": summary,
     }
 
@@ -183,6 +200,14 @@ def render(summary: Dict[str, Any]) -> str:
     for p in summary.get("preempts", ()):
         lines.append(f"  preempt:    signal {p['signal']} at step "
                      f"{p['step']} (emergency save: {p['saved']})")
+    he = summary.get("heals", {})
+    if he.get("count"):
+        shrink = (", shrink " + ", ".join(he["shrinks"])
+                  if he.get("shrinks") else "")
+        lines.append(
+            f"  heal:       {he['count']} in-run recover(ies), "
+            f"{he['downtime_s']:.0f}s down{shrink} | last: "
+            f"{he['last_error']}")
     for name, row in summary["bench"].items():
         lines.append(f"  bench:      {name}: {row}")
     if summary["crash"]:

@@ -65,6 +65,7 @@ class StallWatchdog:
         self._durations = deque(maxlen=window)
         self._last_beat = time.monotonic()
         self._fired = False
+        self._paused = False
         self._stalls = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -84,6 +85,7 @@ class StallWatchdog:
             if duration_s is not None:
                 self._durations.append(float(duration_s))
             self._fired = False
+            self._paused = False
 
     def threshold_s(self) -> float:
         with self._lock:
@@ -92,11 +94,36 @@ class StallWatchdog:
             median = statistics.median(self._durations)
         return max(self.min_stall_s, self.stall_factor * median)
 
+    def pause(self):
+        """Silence the tripwire while a KNOWN no-heartbeat window runs —
+        graftheal calls this at the very start of a recovery, before the
+        (possibly hours-long) backend re-acquisition backoff: the outage
+        is already being handled and will be reported as a ``heal``
+        event; a ``stall`` dump for it would be noise. Re-armed by
+        reset() (the heal's epilogue) or the next beat()."""
+        with self._lock:
+            self._paused = True
+
+    def reset(self):
+        """Forget the trailing window and re-arm with cold-start grace —
+        called after a graftheal recovery (in-process resume): the first
+        post-heal step pays backend re-acquisition plus a fresh XLA
+        compile, and judging it by the pre-loss median would emit a
+        false ``stall`` event (with stack dump) for a healthy recovery.
+        Also lifts pause()."""
+        with self._lock:
+            self._durations.clear()
+            self._last_beat = time.monotonic()
+            self._fired = False
+            self._paused = False
+
     def check(self, now: Optional[float] = None) -> bool:
         """Evaluate the stall condition once; emit at most one event per
         episode. Returns True when a stall event was emitted."""
         now = time.monotonic() if now is None else now
         with self._lock:
+            if self._paused:
+                return False
             waited = now - self._last_beat
             fired = self._fired
             median = (statistics.median(self._durations)

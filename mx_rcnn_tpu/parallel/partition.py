@@ -99,6 +99,41 @@ def flat_segment_specs(params, specs):
     return {d: P() for d in sorted(dtypes)}
 
 
+def elastic_mesh_spec(data: int, model: int, n_devices: int,
+                      micro_batch: int) -> str:
+    """Re-derive a mesh spec when the backend comes back with a different
+    device count (graftheal shrink / elastic resume).
+
+    The contract is GLOBAL-BATCH INVARIANCE: the run's hyperparameters
+    (batch, LR schedule, epoch order) describe the run, not the hardware,
+    so a (data, model) mesh re-cut onto fewer devices keeps the model
+    axis intact (a TP/PP-sharded weight cannot change its partition count
+    mid-run without a resharding story) and shrinks the DATA axis to the
+    largest size that still divides ``micro_batch`` (the per-micro-step
+    global image count) — each surviving device simply carries more batch
+    rows, and the loss trajectory continues up to psum reassociation.
+    With ``n_devices`` at or above the original footprint the original
+    shape is kept (extra devices idle; growth is a scheduling decision,
+    not a recovery).
+    """
+    if n_devices >= data * model:
+        return f"{data}x{model}"
+    if n_devices < model:
+        raise ValueError(
+            f"backend came back with {n_devices} device(s), fewer than the "
+            f"model axis ({model}) — a model-sharded run cannot shrink "
+            "below one data shard; resume from checkpoint on a matching "
+            "topology instead")
+    avail = n_devices // model
+    new_data = next(k for k in range(min(avail, data), 0, -1)
+                    if micro_batch % k == 0)
+    logger.warning(
+        "elastic mesh: %dx%d does not fit %d device(s); re-sharding data "
+        "axis %d -> %d (model axis kept, global micro-batch %d invariant)",
+        data, model, n_devices, data, new_data, micro_batch)
+    return f"{new_data}x{model}"
+
+
 def _path_str(path) -> str:
     parts = []
     for entry in path:

@@ -16,10 +16,16 @@ hung compile can eat an entire bench timeout (BENCH_r05 rc=124). graftscope
   to restart with ``--resume auto``.
 - ``isolate``  — run a callable in a child process under a deadline (the
   bench's per-config jail: a hung compile forfeits one row, not the sweep).
+- ``heal``     — graftheal: a step-time backend loss (mid-run, the part
+  graftguard's startup acquisition could not reach) is recovered
+  IN-PROCESS — emergency capture of the last known-good host state,
+  teardown + re-acquisition under the same deadline, elastic re-shard
+  when the backend returns with fewer devices. No crash, no operator.
 - ``chaos``    — deterministic fault injection (raise UNAVAILABLE on the
-  first N probes, SIGTERM at step K, hang one bench config, SIGKILL at a
-  named site) so every guarantee above is exercised by tier-1 CPU tests
-  instead of by the next real outage.
+  first N probes or mid-run at step K, SIGTERM at step K, hang one bench
+  config, SIGKILL at a named site, shrink the re-acquired device list)
+  so every guarantee above is exercised by tier-1 CPU tests instead of
+  by the next real outage.
 
 Config: the ``resilience`` section of config.py; runbook: OUTAGES.md.
 """
@@ -28,6 +34,11 @@ from mx_rcnn_tpu.resilience.backend import (
     BackendUnavailableError,
     acquire_backend,
     classify_backend_error,
+)
+from mx_rcnn_tpu.resilience.heal import (
+    HealCarry,
+    Healer,
+    host_tree_copy,
 )
 from mx_rcnn_tpu.resilience.preempt import (
     RESUMABLE_RC,
@@ -39,6 +50,9 @@ __all__ = [
     "BackendUnavailableError",
     "acquire_backend",
     "classify_backend_error",
+    "HealCarry",
+    "Healer",
+    "host_tree_copy",
     "RESUMABLE_RC",
     "PreemptionExit",
     "PreemptionGuard",

@@ -80,8 +80,11 @@ def _clear_backend_cache():
     """Drop jax's cached backend set so the next probe re-initializes —
     after a silent CPU fallback the wrong backend is CACHED and no
     amount of retrying would ever observe the recovered relay without
-    this. Only called on the platform-mismatch retry path (clearing a
-    healthy in-process backend would invalidate live arrays)."""
+    this. Two callers, both of which have made live arrays expendable
+    first: the platform-mismatch retry path here (before the first real
+    device touch), and graftheal's teardown (resilience/heal.py — after
+    the emergency capture copied everything worth keeping to host-owned
+    numpy). Anywhere else, clearing would invalidate live arrays."""
     try:
         import jax.extend.backend
 

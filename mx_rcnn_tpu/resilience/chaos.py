@@ -12,11 +12,21 @@ travels in the ``MX_RCNN_CHAOS`` environment variable so subprocess tests
     MX_RCNN_CHAOS="sigterm_at_step=5"              # preempt mid-training
     MX_RCNN_CHAOS="hang_bench=c4_r101 hang_s=60"   # hang one sweep config
     MX_RCNN_CHAOS="die_at=checkpoint_finalize"     # SIGKILL mid-save
+    MX_RCNN_CHAOS="device_lost_at_step=4"          # backend dies mid-run
+    MX_RCNN_CHAOS="device_lost_at_step=4 shrink_on_reacquire=4"  # ...and
+                                                   # returns with 4 devices
 
 Pairs are space- or comma-separated ``key=value``; unknown keys raise (a
 typo'd injection silently doing nothing would un-test the gate it was
 written for). With the variable unset every hook is a no-op costing one
 attribute check. stdlib-only — importable without jax.
+
+Named injection points funnel through ``site(name, ...)`` / the
+pre-parsed ``ChaosSpec.fire(name, ...)``: every site name is registered
+in ``SITES`` and validated both at runtime (an unregistered name raises)
+and at lint time (the ``chaos-site-name`` graftlint rule) — a typo'd
+site string silently never firing is how a "tested" guarantee goes
+untested.
 """
 
 from __future__ import annotations
@@ -28,6 +38,21 @@ import time
 from dataclasses import dataclass
 
 ENV_VAR = "MX_RCNN_CHAOS"
+
+#: The registered injection sites — the ONLY names ``site()``/``fire()``
+#: accept, and the set the ``chaos-site-name`` lint rule resolves call
+#: sites against (it reads this assignment from the AST; keep it a plain
+#: tuple/set literal of string literals).
+SITES = frozenset({
+    "checkpoint_finalize",   # after the full checkpoint write, before the
+                             # publishing rename (train/checkpoint.py)
+    "checkpoint_swap",       # previous checkpoint set aside, new one not
+                             # yet published — the narrowest crash window
+    "train_dispatch",        # just before a train-step dispatch: the
+                             # device_lost_at_step loss fires here
+    "backend_reacquire",     # heal re-acquisition: shrink_on_reacquire
+                             # truncates the recovered device list here
+})
 
 #: Per-process injection state (e.g. how many backend probes have already
 #: been failed) — module-level so repeated ``from_env()`` parses share it.
@@ -53,9 +78,22 @@ class ChaosSpec:
     #: name equals ``hang_bench`` (resilience/isolate.py).
     hang_bench: str = ""
     hang_s: float = 30.0
-    #: SIGKILL the process at a named site ("checkpoint_finalize" /
-    #: "checkpoint_swap" — the save's crash windows, train/checkpoint.py).
+    #: SIGKILL the process at a named site — any member of ``SITES``
+    #: (the save's crash windows "checkpoint_finalize"/"checkpoint_swap",
+    #: the pre-dispatch "train_dispatch", the heal "backend_reacquire").
     die_at: str = ""
+    #: Raise the step-time device-loss signature (transient UNAVAILABLE)
+    #: at the "train_dispatch" site, before the dispatch that would
+    #: complete optimizer step K — the graftheal trigger.
+    device_lost_at_step: int = 0
+    #: How many times the device loss fires (consecutive re-dispatches
+    #: keep failing until this count is spent — the double-loss-inside-
+    #: one-heal-window scenario is device_lost_count=2).
+    device_lost_count: int = 1
+    #: On heal re-acquisition ("backend_reacquire" site) hand back only
+    #: the first N devices — the backend "returns smaller" (spot reclaim
+    #: / partial slice), forcing the elastic re-shard path.
+    shrink_on_reacquire: int = 0
 
     @property
     def active(self) -> bool:
@@ -99,6 +137,51 @@ class ChaosSpec:
         if self.die_at and site == self.die_at:
             os.kill(os.getpid(), signal.SIGKILL)
 
+    def maybe_device_loss(self, step: int):
+        """Raise the transient device-loss signature when the dispatch
+        about to complete optimizer step ``step`` reaches the armed
+        threshold — fires ``device_lost_count`` times total, so a healed
+        re-dispatch can be made to fail again (double loss inside one
+        heal window)."""
+        n = self.device_lost_at_step
+        if n and step >= n:
+            done = _counters.get("device_lost", 0)
+            if done < max(1, self.device_lost_count):
+                _counters["device_lost"] = done + 1
+                raise RuntimeError(
+                    "UNAVAILABLE: TPU backend lost mid-run (Unavailable). "
+                    f"[injected device loss {done + 1}/"
+                    f"{max(1, self.device_lost_count)} at step {step}, "
+                    "chaos]")
+
+    def maybe_shrink(self, devices):
+        """Truncate a re-acquired device list to ``shrink_on_reacquire``
+        devices, if armed — the backend came back smaller."""
+        n = self.shrink_on_reacquire
+        if n and devices is not None and len(devices) > n:
+            return devices[:n]
+        return devices
+
+    def fire(self, name: str, step: int = 0, devices=None):
+        """Dispatch one registered injection site on a PRE-PARSED spec
+        (the hot train loop parses MX_RCNN_CHAOS once and calls this
+        behind an ``active`` check). Returns ``devices`` — possibly
+        truncated — for value sites; None otherwise. Unregistered names
+        raise: see ``SITES``."""
+        if name not in SITES:
+            raise ValueError(
+                f"unregistered chaos site {name!r}; the registered sites "
+                f"are {sorted(SITES)} (add new ones to chaos.SITES)")
+        # EVERY registered site is a valid die_at target (parse validates
+        # die_at against SITES — routing only some of them here would
+        # re-open the armed-but-never-fires hole that check closes).
+        self.maybe_die(name)
+        if name == "train_dispatch":
+            self.maybe_device_loss(step)
+        elif name == "backend_reacquire":
+            devices = self.maybe_shrink(devices)
+        return devices
+
 
 _FIELDS = {f.name: f for f in dataclasses.fields(ChaosSpec)}
 
@@ -130,7 +213,22 @@ def parse(text: str) -> ChaosSpec:
                     f"bad {ENV_VAR} boolean {raw!r} for {key}")
         else:
             kw[key] = raw
+    if kw.get("die_at") and kw["die_at"] not in SITES:
+        # Same hazard class as an unknown key: a typo'd site would arm an
+        # injection that can never fire, silently un-testing its gate.
+        raise ValueError(
+            f"bad {ENV_VAR} die_at site {kw['die_at']!r}; registered "
+            f"sites: {sorted(SITES)}")
     return ChaosSpec(**kw)
+
+
+def site(name: str, step: int = 0, devices=None):
+    """Module-level injection point for COLD paths (checkpoint saves,
+    heal re-acquisition): parses the env spec on every call. Hot paths
+    pre-parse with ``from_env()`` and call ``spec.fire`` directly —
+    which validates the name against ``SITES`` even when no spec is
+    armed."""
+    return from_env().fire(name, step=step, devices=devices)
 
 
 def from_env(environ=os.environ) -> ChaosSpec:

@@ -1,0 +1,237 @@
+"""graftheal — in-run backend-loss recovery and elastic-topology resume.
+
+graftguard (resilience/backend.py, preempt.py) made *startup* fault-
+tolerant and preemption survivable; this module closes the remaining gap
+in the ROADMAP taxonomy: the backend dying **mid-step**. Before graftheal
+a step-time ``UNAVAILABLE`` (the TPU_OUTAGE_r5.log shape, hours into a
+run) was an uncaught RuntimeError — every step since the last checkpoint
+lost, an operator required. Now the train loop's dispatch is wrapped in a
+recovery loop (tools/train.py::fit_detector):
+
+1. **Classify.** A step-time RuntimeError is classified with the PR 5
+   taxonomy (``classify_backend_error``): transient gRPC markers
+   (UNAVAILABLE / DEADLINE_EXCEEDED / ABORTED) heal; anything else — a
+   shape error, an INVALID_ARGUMENT — propagates untouched.
+2. **Capture.** An in-memory emergency capture of the last known-good
+   state: first a *live* capture (``jax.device_get`` of the current
+   train state into host-OWNED numpy copies — tree form even from flat
+   buffers, via ``FlatCore.tree_state``); if the post-loss state is
+   unreadable (donated buffers on a dead backend poison the read), fall
+   back to the standing host snapshot the loop refreshes every
+   ``resilience.heal_snapshot_dispatches`` dispatches — the replayed
+   dispatches are re-derived deterministically (epoch order is
+   f(seed, epoch), per-dispatch keys fold the global index), so the
+   resumed trajectory is the one the uninterrupted run would have taken.
+3. **Re-acquire.** Tear the cached backend down (the clear used for the
+   silent-CPU-fallback path) and re-acquire through the classified
+   retry-with-backoff of ``acquire_backend`` under the SAME
+   ``resilience.backend_deadline_s`` that guards startup.
+4. **Re-shard.** The backend may come back with a DIFFERENT device
+   count (spot reclaim, partial slice): the caller rebuilds the mesh via
+   ``parallel.partition.elastic_mesh_spec`` (model axis preserved, data
+   axis re-cut to the largest batch-divisible size), re-derives
+   partition specs and re-cuts flatcore buffers against the new mesh —
+   the GLOBAL batch is invariant, so the loader, the LR schedule and the
+   loss trajectory carry straight across the shrink.
+
+Each recovery emits one ``heal`` graftscope event (epoch/dispatch,
+classified error, capture mode, downtime, device counts before/after)
+and resets the stall watchdog's trailing median — the first post-heal
+step pays a fresh compile and must not read as a stall.
+
+Consecutive heals with no completed dispatch in between are capped
+(``resilience.heal_consecutive_max``): a fault that recurs instantly is
+not an outage, and re-raising beats looping. Fault injection:
+``MX_RCNN_CHAOS="device_lost_at_step=K"`` raises the loss signature
+before the dispatch that would complete optimizer step K;
+``shrink_on_reacquire=N`` hands recovery only the first N devices
+(resilience/chaos.py). Runbook: OUTAGES.md "mid-run backend loss".
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.resilience import chaos
+from mx_rcnn_tpu.resilience.backend import (
+    _clear_backend_cache,
+    acquire_backend,
+    classify_backend_error,
+)
+
+
+def host_tree_copy(tree):
+    """Host-OWNED numpy copies of a pytree — THE heal-carry invariant:
+    ``np.array`` of every leaf, never zero-copy views of runtime buffers
+    (the backend they came from is about to be torn down, and on the CPU
+    client ``device_get`` can alias the live buffer). Every capture/
+    fallback site goes through here so the invariant lives in one place.
+    jax imported lazily — this module stays importable without it."""
+    import jax
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda x: np.array(jax.device_get(x)), tree)
+
+
+@dataclass
+class HealCarry:
+    """Host-owned training state at a known-good point — what a session
+    is (re)built from. ``params``/``opt_state`` are TREE-form numpy
+    copies (never device views: the backend they came from is about to
+    be torn down); ``opt_state`` is None only for a fresh run's initial
+    carry. ``dispatch`` counts completed dispatches of ``epoch`` —
+    ``(epoch, 0)`` is the epoch boundary. ``bag`` is the drained
+    MetricBag snapshot at the same point, so the resumed epoch's metrics
+    keep accounting for the pre-loss dispatches."""
+
+    params: Any
+    opt_state: Any = None
+    epoch: int = 0
+    dispatch: int = 0
+    bag: Optional[Tuple[Dict[str, float], Dict[str, int]]] = None
+
+
+class Healer:
+    """The in-run recovery engine fit_detector leans on.
+
+    Holds the standing fallback snapshot, the consecutive-failure cap,
+    and the re-acquired device list (``devices`` — None until a heal
+    changed the backend; the session builder re-derives the mesh from it
+    when set). ``rcfg`` is the ``resilience`` config section; ``elog``
+    an optional graftscope EventLog; ``watchdog`` an optional
+    StallWatchdog whose trailing median is reset after each heal.
+    """
+
+    def __init__(self, rcfg, elog=None, watchdog=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rcfg = rcfg
+        self.elog = elog
+        self.watchdog = watchdog
+        self._clock = clock
+        self.heals = 0
+        self.devices = None
+        self._consecutive = 0
+        self._fallback: Optional[HealCarry] = None
+        self._since_snapshot = 0
+        self._n_devices: Optional[int] = None
+        self._footprint: Optional[int] = None
+
+    # -- bookkeeping the train loop drives ---------------------------------
+
+    def note_devices(self, n: int):
+        """Record the session's device count (the heal event's 'before').
+        The largest session ever seen is the run's FOOTPRINT — the cap
+        for reporting re-acquired capacity (a re-grow back toward it
+        after an earlier shrink is a real transition; spare devices
+        beyond it are not)."""
+        self._n_devices = int(n)
+        self._footprint = max(self._footprint or 0, int(n))
+
+    def note_progress(self):
+        """A dispatch completed — the backend is live again; re-arm the
+        consecutive-heal cap."""
+        self._consecutive = 0
+
+    def set_fallback(self, carry: HealCarry):
+        """Install/refresh the standing host snapshot (initial carry,
+        post-heal carry, or a periodic snapshot)."""
+        self._fallback = carry
+
+    def snapshot_due(self) -> bool:
+        """True every ``heal_snapshot_dispatches`` completed dispatches
+        (0 disables periodic snapshots — live capture only)."""
+        every = int(getattr(self.rcfg, "heal_snapshot_dispatches", 0))
+        if every <= 0:
+            return False
+        self._since_snapshot += 1
+        if self._since_snapshot >= every:
+            self._since_snapshot = 0
+            return True
+        return False
+
+    # -- the recovery itself ------------------------------------------------
+
+    def healable(self, exc: BaseException) -> bool:
+        """Should this step-time error be healed in-process? Transient by
+        the PR 5 taxonomy, under the consecutive cap, and heal enabled."""
+        if not getattr(self.rcfg, "heal", False):
+            return False
+        if not isinstance(exc, RuntimeError):
+            return False
+        if self._consecutive >= max(1, int(self.rcfg.heal_consecutive_max)):
+            logger.error(
+                "graftheal: %d consecutive heals without a completed "
+                "dispatch — the fault recurs instantly, giving up",
+                self._consecutive)
+            return False
+        return classify_backend_error(exc) == "transient"
+
+    def recover(self, exc: BaseException,
+                capture: Callable[[], HealCarry]) -> HealCarry:
+        """Capture → teardown → re-acquire. Returns the carry to rebuild
+        the session from; raises ``exc`` (chained) when no state can be
+        captured, and whatever ``acquire_backend`` raises when the
+        backend stays down past the deadline.
+        """
+        t0 = self._clock()
+        if self.watchdog is not None:
+            # The heal window is a KNOWN no-heartbeat stretch (capture +
+            # a possibly hours-long re-acquisition backoff): silence the
+            # stall tripwire for its duration — the outage is reported
+            # as a `heal` event, not a stall dump (reset() below
+            # re-arms).
+            self.watchdog.pause()
+        mode = "live"
+        try:
+            carry = capture()
+        except Exception as cap_exc:  # noqa: BLE001  # graftlint: disable=broad-except — the post-loss state may be unreadable in arbitrary ways (poisoned futures, donated buffers); ANY capture failure routes to the snapshot fallback
+            if self._fallback is None:
+                logger.error(
+                    "graftheal: live capture failed (%s) and no snapshot "
+                    "fallback exists — cannot heal", cap_exc)
+                raise exc from cap_exc
+            carry = self._fallback
+            mode = "snapshot"
+            logger.warning(
+                "graftheal: live capture failed (%s); rolling back to the "
+                "snapshot at epoch %d dispatch %d — the gap replays "
+                "deterministically", cap_exc, carry.epoch, carry.dispatch)
+        # Teardown: drop jax's cached backend so re-acquisition actually
+        # re-initializes (the same clear the silent-CPU-fallback retry
+        # path uses) — probing a dead cached client would fail forever.
+        _clear_backend_cache()
+        devices = acquire_backend(self.rcfg, elog=self.elog)
+        devices = chaos.site("backend_reacquire", devices=devices)
+        downtime = self._clock() - t0
+        before = self._n_devices
+        # The event's "after" is the recovered capacity CAPPED at the
+        # run's FOOTPRINT — not at the previous session's (possibly
+        # shrunken) size, so a re-grow after an earlier shrink reports
+        # as the 4->8 transition it is, while a backend with spare
+        # devices beyond the footprint is not called a grow. The exact
+        # re-cut mesh is logged by the session rebuild.
+        after = (min(len(devices), self._footprint)
+                 if self._footprint else len(devices))
+        self.heals += 1
+        self._consecutive += 1
+        self.devices = devices
+        self.set_fallback(carry)
+        if self.watchdog is not None:
+            # The pre-loss trailing median must not judge the first
+            # post-heal step (re-acquire + fresh compile): cold grace.
+            self.watchdog.reset()
+        if self.elog is not None and self.elog.enabled:
+            self.elog.emit("heal", epoch=carry.epoch, dispatch=carry.dispatch,
+                           error=str(exc)[:500], mode=mode,
+                           downtime_s=round(downtime, 3),
+                           devices_before=before,
+                           devices_after=after)
+        logger.warning(
+            "graftheal: healed step-time backend loss at epoch %d dispatch "
+            "%d (%s capture, %.1fs down, devices %s -> %d): %s",
+            carry.epoch, carry.dispatch, mode, downtime, before, after, exc)
+        return carry

@@ -23,17 +23,25 @@ from mx_rcnn_tpu.models.zoo import build_model, forward_train, init_params
 from mx_rcnn_tpu.obs import StallWatchdog, StepTimer, obs_from_config, run_meta_fields
 from mx_rcnn_tpu.obs import compile_track
 from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
-from mx_rcnn_tpu.resilience import PreemptionExit, PreemptionGuard, acquire_backend
+from mx_rcnn_tpu.resilience import (
+    HealCarry,
+    Healer,
+    PreemptionExit,
+    PreemptionGuard,
+    acquire_backend,
+    host_tree_copy,
+)
 from mx_rcnn_tpu.resilience import chaos
 from mx_rcnn_tpu.train.callback import Speedometer
 from mx_rcnn_tpu.train.checkpoint import (
+    checkpoint_meta,
     latest_checkpoint,
     latest_epoch,
     load_checkpoint,
     save_checkpoint,
 )
 from mx_rcnn_tpu.train.metrics import MetricBag
-from mx_rcnn_tpu.train.optimizer import build_optimizer
+from mx_rcnn_tpu.train.optimizer import build_optimizer, rebase_schedule_count
 from mx_rcnn_tpu.train.step import create_train_state, make_train_step
 
 
@@ -113,6 +121,19 @@ def fit_detector(
     boundary — emergency checkpoint (resilience.preempt_save), `preempt`
     event, then PreemptionExit carrying RESUMABLE_RC (75).
 
+    graftheal (resilience/heal.py; resilience.heal, default on): a
+    TRANSIENT step-time backend loss no longer kills the run — the loop
+    captures the last known-good host state in memory, re-acquires the
+    backend under resilience.backend_deadline_s, rebuilds the session
+    (mesh, partition specs, flat buffers re-cut) and continues, emitting
+    a `heal` event. If the backend returns with fewer devices the data
+    axis is re-cut to the largest batch-divisible size — the GLOBAL
+    batch is invariant, so the loader stream, LR schedule and loss
+    trajectory carry straight across the shrink. Checkpoints carry a
+    topology sidecar (graft_meta.json) so `--resume auto` onto a
+    DIFFERENT device count recomputes the dispatch skip through the
+    images-consumed invariant.
+
     With train.async_checkpoint (default, single-process) the epoch-end
     save is enqueued, not durable, when epoch_callback runs — a callback
     that READS the just-saved checkpoint from disk must not assume it has
@@ -121,7 +142,10 @@ def fit_detector(
     epoch_callback(epoch, state, bag): with train.flat_params the state is
     a FlatTrainState — `.step` and `.params` (host-owned copies) keep
     working, but there is no `.opt_state` tree; use the checkpoint for
-    optimizer inspection.
+    optimizer inspection. A graftheal recovery that lands inside the
+    epoch-end window REPLAYS it (event, save, callback) rather than
+    dropping it — callbacks should tolerate a rare re-invocation for
+    the same epoch.
     """
     from mx_rcnn_tpu.parallel.distributed import is_primary, local_data_shards
 
@@ -140,6 +164,9 @@ def fit_detector(
     # Each process feeds only its own slice of the data axis (multi-host:
     # parallel/distributed.py; single-process: n_local == n_data).
     n_local = local_data_shards(mesh)
+    # The run's NOMINAL footprint — what graftheal's elastic re-shard
+    # derives the post-loss mesh from (parallel/partition.py).
+    d0, m0 = n_data, mesh.shape["model"]
     logger.info("mesh: %s (data=%d model=%d, %d local shards)",
                 mesh.devices.shape, n_data, mesh.shape["model"], n_local)
 
@@ -192,13 +219,25 @@ def fit_detector(
             loader = loader_factory(roidb, loader_cfg, n_local)
     steps_per_epoch = max(len(loader), 1)
 
+    # Global images per dispatch — the run's INVARIANT unit of progress.
+    # graftheal keeps it fixed across an elastic shrink (the surviving
+    # devices carry more rows each), and the checkpoint meta sidecar
+    # records it so a resume onto a different topology can convert a
+    # dispatch tag minted under another mesh (see below).
+    multi = max(1, cfg.train.multi_step_dispatch)
+    ipd = cfg.train.batch_images * accum * n_data * multi
+    disp_per_epoch = max(1, steps_per_epoch // multi)
+    if multi > 1 and len(loader) % multi:
+        logger.warning(
+            "multi_step_dispatch=%d drops %d trailing batch(es) per epoch "
+            "(loader yields %d)", multi, len(loader) % multi, len(loader))
+
     # Resume discovery BEFORE building the optimizer: a restored opt_state
     # carries optax's schedule counter; without one the LR schedule is
     # offset by begin_step instead (never both — that would double-count).
     # resume=True sees epoch-boundary checkpoints only; resume="auto"
     # (graftguard) also picks up dispatch-tagged emergency saves and
     # restarts mid-epoch from the most-advanced point.
-    multi = max(1, cfg.train.multi_step_dispatch)
     resume_epoch = resume_dispatch = None
     if resume == "auto":
         found = latest_checkpoint(prefix)
@@ -208,108 +247,65 @@ def fit_detector(
         resume_epoch = latest_epoch(prefix)
     skip_dispatch = resume_dispatch or 0
     opt_state = None
-    sched_begin = 0
     if resume_epoch is not None:
         begin_epoch = resume_epoch
-        tx = build_optimizer(cfg, params, steps_per_epoch)
+        tx_tmpl = build_optimizer(cfg, params, steps_per_epoch)
         params, opt_state = load_checkpoint(
             prefix, resume_epoch, dispatch=resume_dispatch,
             template={"params": params},
-            opt_state_template=tx.init(params),
+            opt_state_template=tx_tmpl.init(params),
             means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
             num_classes=cfg.dataset.num_classes)
+        # Elastic resume (graftheal): the meta sidecar records the SAVING
+        # run's topology. When it differs from this run's, two
+        # conversions apply — to boundary checkpoints and dispatch-0
+        # emergency saves just as much as to mid-epoch ones:
+        meta = checkpoint_meta(prefix, resume_epoch, resume_dispatch)
+        old_ipd = (meta or {}).get("images_per_dispatch")
+        if old_ipd and old_ipd != ipd:
+            if skip_dispatch:
+                # (1) A dispatch tag counts dispatches AT THE SAVING
+                # RUN'S global batch — convert through the invariant,
+                # images consumed, so the trained prefix of the epoch is
+                # skipped exactly (floor: a non-divisible remainder
+                # re-trains up to one old dispatch rather than skipping
+                # unseen images).
+                images_done = skip_dispatch * int(old_ipd)
+                skip_dispatch = images_done // ipd
+                logger.warning(
+                    "elastic resume: checkpoint was saved at %d images/"
+                    "dispatch (device_count=%s), this run dispatches %d — "
+                    "skip recomputed to %d dispatch(es) (%d of %d images"
+                    "%s)", old_ipd, (meta or {}).get("device_count", "?"),
+                    ipd, skip_dispatch, skip_dispatch * ipd, images_done,
+                    "" if images_done % ipd == 0 else
+                    f"; {images_done % ipd} image(s) re-trained")
+            if opt_state is not None:
+                # (2) The restored schedule/Adam counters are in the
+                # SAVING run's step units; this run counts against ITS
+                # steps_per_epoch and schedule — rebase, or every
+                # warmup/decay read happens at the old run's position
+                # (train/optimizer.py).
+                opt_state = rebase_schedule_count(
+                    opt_state,
+                    begin_epoch * steps_per_epoch + skip_dispatch * multi)
+                logger.warning(
+                    "elastic resume: optimizer counters rebased to step "
+                    "%d (this run's units)",
+                    begin_epoch * steps_per_epoch + skip_dispatch * multi)
         logger.info("resumed from %s epoch %d%s (opt_state %s)", prefix,
                     resume_epoch,
-                    f" dispatch {resume_dispatch}" if resume_dispatch
-                    else "",
+                    f" dispatch {resume_dispatch}"
+                    if resume_dispatch is not None else "",
                     "restored" if opt_state is not None
                     else "reinitialized")
-        if opt_state is None:
-            sched_begin = (begin_epoch * steps_per_epoch
-                           + skip_dispatch * multi)
-            tx = build_optimizer(cfg, params, steps_per_epoch,
-                                 begin_step=sched_begin)
-    else:
-        sched_begin = begin_epoch * steps_per_epoch
-        tx = build_optimizer(cfg, params, steps_per_epoch,
-                             begin_step=sched_begin)
 
-    state = create_train_state(params, tx)
-    if opt_state is not None:
-        state = state.replace(opt_state=opt_state)
-    if begin_epoch or skip_dispatch:
-        state = state.replace(
-            step=jax.numpy.asarray(
-                begin_epoch * steps_per_epoch + skip_dispatch * multi,
-                jax.numpy.int32))
-
-    param_specs = None
-    if cfg.network.tensor_parallel:
-        if "model" in mesh.axis_names and mesh.shape["model"] > 1:
-            from mx_rcnn_tpu.parallel.partition import (
-                shard_train_state, tp_param_specs)
-
-            param_specs = tp_param_specs(state.params)
-            state = shard_train_state(state, mesh, param_specs)
-        else:
-            logger.warning(
-                "network.tensor_parallel ignored: mesh model axis is 1 "
-                "(build the mesh as '<data>x<model>', e.g. --tpu-mesh 4x2)")
-
-    # flatcore (train/flatcore.py): persistent flat parameter/optimizer
-    # storage — the update becomes a handful of fused kernels and the DP
-    # allreduce one psum per buffer. TP/PP (sharded-leaf) runs route back
-    # to the per-leaf path inside flat_mode_for. Checkpoints stay in TREE
-    # form on disk (tree_state below), so the restore above and every
-    # other consumer are mode-agnostic.
-    flat_core = None
-    if getattr(cfg.train, "flat_params", False):
-        from mx_rcnn_tpu.train import flatcore as _flatcore
-
-        if _flatcore.flat_mode_for(cfg, params=state.params,
-                                   param_specs=param_specs):
-            flat_core = _flatcore.FlatCore(cfg, state.params,
-                                           steps_per_epoch,
-                                           begin_step=sched_begin)
-            if opt_state is not None:
-                state = flat_core.flatten_state(state)
-            else:
-                # Fresh slots: build the flat state directly —
-                # flatten_state would device_get every zero leaf of the
-                # per-leaf opt_state just to re-upload it as flat zeros.
-                state = flat_core.init_state(state.params).replace(
-                    step=jax.numpy.asarray(state.step, jax.numpy.int32))
-            logger.info(
-                "flatcore: %d leaves -> %d flat buffer(s) %s",
-                len(flat_core.table.segments), len(flat_core.table.sizes),
-                {d: n for d, n in flat_core.table.sizes.items()})
-
-    # Flat mode on the CPU backend: donation of the ~100 MB flat buffers
-    # races the CPU client's async execution — the donated input of an
-    # enqueued step can be reclaimed (munmapped, these sizes are direct
-    # mmaps) while still referenced, and the process segfaults at an
-    # unrelated later allocation/read (observed in the tier-1 flat smoke;
-    # crash site wanders: eager fold_in, device_get, logging). Donation
-    # is an HBM-footprint optimization — on the host-memory backend
-    # correctness wins. TPU keeps it.
-    flat_donate = not (flat_core is not None
-                       and jax.default_backend() == "cpu")
-    step_fn = make_train_step(model, cfg, mesh=mesh,
-                              donate=flat_donate,
-                              forward_fn=forward_fn or forward_train,
-                              param_specs=param_specs,
-                              flat_core=flat_core)
-    # Per-dispatch rng keys are derived from the dispatch's GLOBAL index
-    # (fold_in), not a run-position-dependent split chain — so a resumed
-    # run consumes exactly the keys the uninterrupted run would have (the
-    # kill→resume bit-exactness gate), at O(1) resume cost.
-    rng = jax.random.PRNGKey(seed + 1)
-    disp_per_epoch = max(1, steps_per_epoch // multi)
-    if multi > 1 and len(loader) % multi:
-        logger.warning(
-            "multi_step_dispatch=%d drops %d trailing batch(es) per epoch "
-            "(loader yields %d)", multi, len(loader) % multi, len(loader))
-    batch_size = cfg.train.batch_images * accum * n_data * multi
+    # The session carry: host-side (params, opt_state, position) every
+    # device-facing object is (re)built from — initially the fresh/
+    # resumed state above, then whatever graftheal captured. opt_state
+    # None => fresh slots, LR schedule offset by begin_step instead.
+    carry = HealCarry(params=params, opt_state=opt_state,
+                      epoch=begin_epoch, dispatch=skip_dispatch)
 
     # graftscope telemetry (mx_rcnn_tpu/obs): the sink was opened at the
     # top of this function (backend acquisition emits through it); a
@@ -317,7 +313,7 @@ def fit_detector(
     watchdog = None
     if obs_log.enabled:
         obs_log.emit("run_meta", **run_meta_fields(
-            cfg, mesh=mesh, prefix=prefix, batch_size=batch_size,
+            cfg, mesh=mesh, prefix=prefix, batch_size=ipd,
             steps_per_epoch=steps_per_epoch, begin_epoch=begin_epoch,
             end_epoch=end_epoch, grad_accum=accum,
             multi_step_dispatch=multi))
@@ -330,14 +326,17 @@ def fit_detector(
                 poll_s=cfg.obs.watchdog_poll_s)
             watchdog.start()
     timer = StepTimer(obs_log, watchdog=watchdog)
-    speedometer = Speedometer(batch_size, frequent, event_log=obs_log)
+    speedometer = Speedometer(ipd, frequent, event_log=obs_log)
 
     # Async epoch-end saves (train/checkpoint.py CheckpointWriter); the
     # multi-host primary-only pattern needs the synchronous path (orbax's
     # cross-process commit barrier would hang with one caller).
     writer = None
     if cfg.train.async_checkpoint and jax.process_count() == 1:
-        if flat_core is not None and jax.default_backend() == "cpu":
+        from mx_rcnn_tpu.train import flatcore as _flatcore
+
+        if (_flatcore.flat_mode_for(cfg)
+                and jax.default_backend() == "cpu"):
             # Flat mode on the CPU backend: the background tensorstore
             # write racing the flat step's large host-buffer churn
             # (113+ MB donated buffers and backward concatenates every
@@ -345,6 +344,9 @@ def fit_detector(
             # free(): invalid pointer under MALLOC_CHECK_ with flat+async
             # only; tree+async and flat+sync run clean. On TPU the step's
             # buffers live in HBM, not host malloc, so async stays on.
+            # (flat_mode_for without params is the cfg-level routing —
+            # the rare TP-spec downgrade inside the session would only
+            # make this choice conservative, never unsafe.)
             logger.info("flatcore on CPU backend: epoch checkpoints go "
                         "synchronous (async writer would race the flat "
                         "step's host allocator)")
@@ -362,6 +364,70 @@ def fit_detector(
         guard.install()
     chaos_spec = chaos.from_env()
 
+    # graftheal (resilience/heal.py): a transient step-time backend loss
+    # is recovered IN-PROCESS — capture the last known-good host state,
+    # tear down + re-acquire the backend under the deadline, rebuild the
+    # session (possibly on fewer devices) and continue. The initial
+    # fallback is a host-owned copy of the starting state, refreshed by
+    # periodic snapshots and by every successful capture.
+    healer = None
+    if cfg.resilience.heal and jax.process_count() > 1:
+        # Multi-host heal needs coordination this PR does not have: one
+        # process tearing its backend down mid-collective would wedge
+        # the others, and the post-heal topology must be agreed across
+        # hosts (the ROADMAP multi-host item). Stay inert — preemption +
+        # --resume auto still covers the fleet case.
+        logger.warning("resilience.heal is single-process only for now; "
+                       "disabled under jax.process_count()=%d",
+                       jax.process_count())
+    elif cfg.resilience.heal:
+        healer = Healer(cfg.resilience, elog=obs_log, watchdog=watchdog)
+        healer.set_fallback(HealCarry(
+            params=host_tree_copy(carry.params),
+            opt_state=host_tree_copy(carry.opt_state),
+            epoch=carry.epoch, dispatch=carry.dispatch))
+
+    # Per-session device-facing objects, (re)assigned by the session loop
+    # below; declared here so the closures and the return path see them.
+    state = flat_core = bag = None
+    pos = (carry.epoch, carry.dispatch)
+
+    def _ckpt_meta(at_epoch: int, at_dispatch: Optional[int]):
+        """The topology sidecar (train/checkpoint.py::META_NAME): what a
+        dispatch WAS when this checkpoint was cut, so an elastic resume
+        can convert the tag (see the skip recompute above)."""
+        return {"epoch": at_epoch, "dispatch": at_dispatch,
+                "images_per_dispatch": ipd,
+                "steps_per_epoch": steps_per_epoch,
+                "device_count": int(mesh.devices.size),
+                "mesh": {a: int(s) for a, s in
+                         zip(mesh.axis_names, mesh.devices.shape)}}
+
+    def _capture() -> HealCarry:
+        """graftheal's in-memory emergency capture: the live train state
+        as host-OWNED tree-form copies (np.array, never device views —
+        the backend they came from is about to be torn down), tagged
+        with its position and the drained metric sums."""
+        if state is None:
+            raise RuntimeError("no live state to capture yet")
+        if flat_core is not None:
+            cap_params, cap_opt = flat_core.tree_state(state)
+        else:
+            cap_params = host_tree_copy(state.params)
+            cap_opt = host_tree_copy(state.opt_state)
+        if sched_begin:
+            # This session's optimizer was built FRESH with its schedule
+            # offset by begin_step, so its counters are session-relative
+            # (they started at 0 mid-run). The carry contract is
+            # ABSOLUTE counters — rebuilds use begin_step=0 whenever an
+            # opt_state is present — so normalize to the capture
+            # position (== sched_begin + updates this session).
+            cap_opt = rebase_schedule_count(
+                cap_opt, pos[0] * steps_per_epoch + pos[1] * multi)
+        return HealCarry(params=cap_params, opt_state=cap_opt,
+                         epoch=pos[0], dispatch=pos[1],
+                         bag=bag.snapshot() if bag is not None else None)
+
     def _honor_preemption(at_epoch: int, at_dispatch: Optional[int],
                           need_save: bool = True):
         """Orderly preemption exit: emergency checkpoint (sync — it must
@@ -377,7 +443,8 @@ def fit_detector(
             saved = save_checkpoint(
                 prefix, at_epoch, save_params, save_opt,
                 means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
-                num_classes=cfg.dataset.num_classes, dispatch=at_dispatch)
+                num_classes=cfg.dataset.num_classes, dispatch=at_dispatch,
+                meta=_ckpt_meta(at_epoch, at_dispatch))
         if obs_log.enabled:
             obs_log.emit("preempt", signal=guard.signum,
                          step=(at_epoch * steps_per_epoch
@@ -390,77 +457,262 @@ def fit_detector(
         raise PreemptionExit(guard.signum)
 
     try:
-        for epoch in range(begin_epoch, end_epoch):
-            if hasattr(loader, "set_epoch"):
-                # epoch order = f(seed, epoch): a resumed epoch replays
-                # exactly the order the uninterrupted run saw.
-                loader.set_epoch(epoch)
-            skip = skip_dispatch if epoch == begin_epoch else 0
-            batches = _dispatch_batches(loader, multi)
-            if skip:
-                logger.info("mid-epoch resume: skipping %d already-"
-                            "trained dispatch(es) of epoch %d", skip, epoch)
-                batches = itertools.islice(batches, skip, None)
-            bag = MetricBag()
-            # start=skip keeps i the TRUE epoch-local dispatch index on a
-            # mid-epoch resume — telemetry/log batch numbers continue
-            # where the interrupted run stopped rather than restarting
-            # at 0 over indices it already recorded.
-            for i, batch in timer.iterate(epoch, batches, start=skip):
-                k = jax.random.fold_in(  # graftlint: disable=prng-key-reuse — the root is folded with a DISTINCT global dispatch index each iteration (the resumable-key derivation; see the rng comment above)
-                    rng, epoch * disp_per_epoch + i)
-                state, metrics = step_fn(
-                    state, shard_batch(batch, mesh, stacked=multi > 1), k)
-                timer.dispatched()
-                bag.update(metrics)
-                speedometer(epoch, i, bag)
-                done = i + 1  # dispatches complete in this epoch
-                if chaos_spec.active:
-                    chaos_spec.maybe_sigterm(
-                        epoch * steps_per_epoch + done * multi)
-                if guard is not None and guard.requested:
-                    _honor_preemption(epoch, done)
-            logger.info("Epoch[%d] done. %s", epoch, bag.format())
-            if obs_log.enabled:
-                # bag.format() above already drained the pending device
-                # scalars — this get() re-reads host-side sums only.
-                obs_log.emit("epoch", epoch=epoch, metrics=bag.get())
-            # checkpoint_period > 1 (long small-epoch runs, e.g. the DETR
-            # gate's 150 epochs): save every Nth epoch and always the last —
-            # resume granularity traded against orbax save time.
-            # Explicit loader shutdown at epoch end: the epoch generator's
-            # finally already STOPPED the prefetcher when the loop drained
-            # it; close() additionally joins the worker threads so none
-            # outlive the epoch (data/loader.py).
-            if hasattr(loader, "close"):
-                loader.close()
-            epoch_saved = False
-            if is_primary() and ((epoch + 1) % max(1, checkpoint_period) == 0
-                                 or epoch + 1 == end_epoch):
-                if flat_core is not None:
-                    # on-disk form is ALWAYS the tree form — checkpoints
-                    # stay interchangeable between flat and tree modes
-                    save_params, save_opt = flat_core.tree_state(state)
-                else:
-                    save_params, save_opt = state.params, state.opt_state
-                save = writer.save if writer is not None else save_checkpoint
-                save(prefix, epoch + 1, save_params, save_opt,
-                     means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
-                     num_classes=cfg.dataset.num_classes)
-                epoch_saved = True
-                if obs_log.enabled:
-                    obs_log.emit("checkpoint", epoch=epoch + 1,
-                                 prefix=prefix,
-                                 durable=writer is None)
-            if epoch_callback:
-                epoch_callback(epoch, state, bag)
-            if guard is not None and guard.requested:
-                # Signal landed during epoch-end work: exit at the
-                # boundary. The save just enqueued (if any) goes durable
-                # in the finally below (writer.close publishes it);
-                # otherwise (checkpoint_period skipped this epoch) write
-                # a boundary checkpoint now so nothing is lost.
-                _honor_preemption(epoch + 1, None, need_save=not epoch_saved)
+        while True:  # one iteration per backend session; graftheal re-enters
+            try:
+                state = flat_core = bag = None
+                pos = (carry.epoch, carry.dispatch)
+                if healer is not None:
+                    if healer.devices is not None:
+                        # Re-acquired backend, possibly smaller: re-cut
+                        # the mesh (model axis kept, data axis re-derived
+                        # — global batch invariant, so the loader and the
+                        # schedule carry straight across) and re-derive
+                        # everything device-facing against it.
+                        from mx_rcnn_tpu.parallel.partition import (
+                            elastic_mesh_spec)
+
+                        respec = elastic_mesh_spec(
+                            d0, m0, len(healer.devices),
+                            cfg.train.batch_images * n_data)
+                        mesh = create_mesh(respec, devices=healer.devices)
+                        model = build_model(cfg, mesh=mesh)
+                        logger.info(
+                            "graftheal: session rebuilt on mesh %s "
+                            "(%d device(s))", dict(zip(
+                                mesh.axis_names,
+                                (int(s) for s in mesh.devices.shape))),
+                            int(mesh.devices.size))
+                    healer.note_devices(int(mesh.devices.size))
+
+                # Optimizer/state from the carry: a restored opt_state
+                # brings optax's schedule counter; a fresh one offsets
+                # the schedule by begin_step instead (never both).
+                b_epoch, b_skip = carry.epoch, carry.dispatch
+                sched_begin = (0 if carry.opt_state is not None
+                               else b_epoch * steps_per_epoch
+                               + b_skip * multi)
+                tx = build_optimizer(cfg, carry.params, steps_per_epoch,
+                                     begin_step=sched_begin)
+                state = create_train_state(carry.params, tx)
+                if carry.opt_state is not None:
+                    state = state.replace(opt_state=carry.opt_state)
+                if b_epoch or b_skip:
+                    state = state.replace(
+                        step=jax.numpy.asarray(
+                            b_epoch * steps_per_epoch + b_skip * multi,
+                            jax.numpy.int32))
+
+                # Partition specs are RE-DERIVED against the session's
+                # mesh — after an elastic shrink the same rules bind to
+                # the new model/data axes (parallel/partition.py).
+                param_specs = None
+                if cfg.network.tensor_parallel:
+                    if ("model" in mesh.axis_names
+                            and mesh.shape["model"] > 1):
+                        from mx_rcnn_tpu.parallel.partition import (
+                            shard_train_state, tp_param_specs)
+
+                        param_specs = tp_param_specs(state.params)
+                        state = shard_train_state(state, mesh, param_specs)
+                    else:
+                        logger.warning(
+                            "network.tensor_parallel ignored: mesh model "
+                            "axis is 1 (build the mesh as '<data>x"
+                            "<model>', e.g. --tpu-mesh 4x2)")
+
+                # flatcore (train/flatcore.py): persistent flat parameter/
+                # optimizer storage — the update becomes a handful of
+                # fused kernels and the DP allreduce one psum per buffer.
+                # TP/PP (sharded-leaf) runs route back to the per-leaf
+                # path inside flat_mode_for. Checkpoints (and the heal
+                # carry) stay in TREE form — tree_state below — so every
+                # restore path is mode-agnostic and a healed session
+                # simply RE-CUTS the buffers via the SegmentTable.
+                if getattr(cfg.train, "flat_params", False):
+                    from mx_rcnn_tpu.train import flatcore as _flatcore
+
+                    if _flatcore.flat_mode_for(cfg, params=state.params,
+                                               param_specs=param_specs):
+                        flat_core = _flatcore.FlatCore(
+                            cfg, state.params, steps_per_epoch,
+                            begin_step=sched_begin)
+                        if carry.opt_state is not None:
+                            state = flat_core.flatten_state(state)
+                        else:
+                            # Fresh slots: build the flat state directly —
+                            # flatten_state would device_get every zero
+                            # leaf of the per-leaf opt_state just to
+                            # re-upload it as flat zeros.
+                            state = flat_core.init_state(
+                                state.params).replace(
+                                step=jax.numpy.asarray(state.step,
+                                                       jax.numpy.int32))
+                        logger.info(
+                            "flatcore: %d leaves -> %d flat buffer(s) %s",
+                            len(flat_core.table.segments),
+                            len(flat_core.table.sizes),
+                            {d: n for d, n
+                             in flat_core.table.sizes.items()})
+
+                # Donation on the CPU backend is OFF — for every storage
+                # mode, not just flat. Two observed corruption families:
+                # (1) PR 5's flat crash — donating the ~100 MB flat
+                # buffers races the CPU client's async execution (the
+                # donated input of an enqueued step is reclaimed/
+                # munmapped while referenced; segfault wanders over
+                # later allocs); (2) the graftheal/resume shape — a
+                # session rebuilt from HOST numpy trees (checkpoint
+                # restore, heal carry) feeds numpy-backed arrays into a
+                # donating step, and CPU zero-copy + donation writes
+                # into/frees memory numpy owns (observed in the heal
+                # shrink gate as 1e18 losses one dispatch after the
+                # heal, or a segfault). Donation is an HBM-footprint
+                # optimization — on the host-memory backend correctness
+                # wins. TPU keeps it.
+                donate = jax.default_backend() != "cpu"
+                step_fn = make_train_step(model, cfg, mesh=mesh,
+                                          donate=donate,
+                                          forward_fn=(forward_fn
+                                                      or forward_train),
+                                          param_specs=param_specs,
+                                          flat_core=flat_core)
+                # Per-dispatch rng keys are derived from the dispatch's
+                # GLOBAL index (fold_in), not a run-position-dependent
+                # split chain — so a resumed/healed run consumes exactly
+                # the keys the uninterrupted run would have (the
+                # kill→resume bit-exactness gate), at O(1) resume cost.
+                rng = jax.random.PRNGKey(seed + 1)
+
+                for epoch in range(b_epoch, end_epoch):
+                    if hasattr(loader, "set_epoch"):
+                        # epoch order = f(seed, epoch): a resumed epoch
+                        # replays exactly the order the uninterrupted run
+                        # saw.
+                        loader.set_epoch(epoch)
+                    skip = b_skip if epoch == b_epoch else 0
+                    batches = _dispatch_batches(loader, multi)
+                    if skip:
+                        logger.info(
+                            "mid-epoch resume: skipping %d already-"
+                            "trained dispatch(es) of epoch %d", skip,
+                            epoch)
+                        batches = itertools.islice(batches, skip, None)
+                    bag = MetricBag()
+                    if skip and carry.bag is not None \
+                            and epoch == carry.epoch:
+                        # Healed mid-epoch: keep accounting for the
+                        # pre-loss dispatches so the epoch log/event
+                        # covers the whole epoch, not just the remainder.
+                        bag.restore(carry.bag)
+                    pos = (epoch, skip)
+                    # start=skip keeps i the TRUE epoch-local dispatch
+                    # index on a mid-epoch resume — telemetry/log batch
+                    # numbers continue where the interrupted run stopped
+                    # rather than restarting at 0 over indices it already
+                    # recorded.
+                    for i, batch in timer.iterate(epoch, batches,
+                                                  start=skip):
+                        if chaos_spec.active:
+                            # chaos site "train_dispatch": the injected
+                            # device loss (device_lost_at_step) fires
+                            # before the dispatch that would complete
+                            # optimizer step K.
+                            chaos_spec.fire(
+                                "train_dispatch",
+                                step=(epoch * steps_per_epoch
+                                      + (i + 1) * multi))
+                        k = jax.random.fold_in(  # graftlint: disable=prng-key-reuse — the root is folded with a DISTINCT global dispatch index each iteration (the resumable-key derivation; see the rng comment above)
+                            rng, epoch * disp_per_epoch + i)
+                        state, metrics = step_fn(
+                            state,
+                            shard_batch(batch, mesh, stacked=multi > 1),
+                            k)
+                        pos = (epoch, i + 1)
+                        timer.dispatched()
+                        bag.update(metrics)
+                        speedometer(epoch, i, bag)
+                        done = i + 1  # dispatches complete in this epoch
+                        if healer is not None:
+                            healer.note_progress()
+                            if healer.snapshot_due():
+                                healer.set_fallback(_capture())
+                        if chaos_spec.active:
+                            chaos_spec.maybe_sigterm(
+                                epoch * steps_per_epoch + done * multi)
+                        if guard is not None and guard.requested:
+                            _honor_preemption(epoch, done)
+                    # pos stays at (epoch, <last dispatch>) until the
+                    # epoch-end work below completes: a heal landing
+                    # inside this window then REPLAYS the whole block
+                    # (the islice skips every dispatch, the bag restores
+                    # from the carry) — the epoch event, the boundary
+                    # save (a re-save is atomic and idempotent) and the
+                    # epoch_callback all run instead of being silently
+                    # dropped. Epoch callbacks should tolerate a rare
+                    # re-invocation for the same epoch.
+                    logger.info("Epoch[%d] done. %s", epoch, bag.format())
+                    if obs_log.enabled:
+                        # bag.format() above already drained the pending
+                        # device scalars — this get() re-reads host-side
+                        # sums only.
+                        obs_log.emit("epoch", epoch=epoch,
+                                     metrics=bag.get())
+                    # checkpoint_period > 1 (long small-epoch runs, e.g.
+                    # the DETR gate's 150 epochs): save every Nth epoch
+                    # and always the last — resume granularity traded
+                    # against orbax save time.
+                    # Explicit loader shutdown at epoch end: the epoch
+                    # generator's finally already STOPPED the prefetcher
+                    # when the loop drained it; close() additionally
+                    # joins the worker threads so none outlive the epoch
+                    # (data/loader.py).
+                    if hasattr(loader, "close"):
+                        loader.close()
+                    epoch_saved = False
+                    if is_primary() and (
+                            (epoch + 1) % max(1, checkpoint_period) == 0
+                            or epoch + 1 == end_epoch):
+                        if flat_core is not None:
+                            # on-disk form is ALWAYS the tree form —
+                            # checkpoints stay interchangeable between
+                            # flat and tree modes
+                            save_params, save_opt = flat_core.tree_state(
+                                state)
+                        else:
+                            save_params, save_opt = (state.params,
+                                                     state.opt_state)
+                        save = (writer.save if writer is not None
+                                else save_checkpoint)
+                        save(prefix, epoch + 1, save_params, save_opt,
+                             means=cfg.train.bbox_means,
+                             stds=cfg.train.bbox_stds,
+                             num_classes=cfg.dataset.num_classes,
+                             meta=_ckpt_meta(epoch + 1, None))
+                        epoch_saved = True
+                        if obs_log.enabled:
+                            obs_log.emit("checkpoint", epoch=epoch + 1,
+                                         prefix=prefix,
+                                         durable=writer is None)
+                    if epoch_callback:
+                        epoch_callback(epoch, state, bag)
+                    if guard is not None and guard.requested:
+                        # Signal landed during epoch-end work: exit at
+                        # the boundary. The save just enqueued (if any)
+                        # goes durable in the finally below (writer.close
+                        # publishes it); otherwise (checkpoint_period
+                        # skipped this epoch) write a boundary checkpoint
+                        # now so nothing is lost.
+                        _honor_preemption(epoch + 1, None,
+                                          need_save=not epoch_saved)
+                    pos = (epoch + 1, 0)
+                break  # trained through end_epoch — leave the session loop
+            except RuntimeError as exc:
+                # Step-time device/backend loss: heal in-process when the
+                # PR 5 taxonomy says transient (and the consecutive-heal
+                # cap has headroom); anything else propagates untouched.
+                if healer is None or not healer.healable(exc):
+                    raise
+                carry = healer.recover(exc, _capture)
     except BaseException as exc:  # graftlint: disable=broad-except — crash telemetry, re-raised below
         if obs_log.enabled and not isinstance(exc, PreemptionExit):
             import traceback

@@ -16,10 +16,11 @@ inference via load_checkpoint round-trips to identical predictions.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import shutil
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +43,41 @@ def checkpoint_name(epoch: int, dispatch: Optional[int] = None) -> str:
     if dispatch is None:
         return f"{epoch:04d}"
     return f"{epoch:04d}d{dispatch:05d}"
+
+
+#: Topology sidecar inside a checkpoint dir (written into the tmp dir, so
+#: it publishes atomically with the arrays). The graftheal axis of the
+#: tree-form contract: records how big a dispatch WAS (global images per
+#: dispatch, device count, mesh) so a resume onto a different topology
+#: can recompute the dispatch skip against ITS global batch instead of
+#: trusting a tag minted under another mesh. Orbax ignores the extra
+#: file; checkpoints without one (pre-graftheal) restore as before.
+META_NAME = "graft_meta.json"
+
+
+def _write_meta(ckpt_dir: str, meta: Dict[str, Any]):
+    with open(os.path.join(ckpt_dir, META_NAME), "w",
+              encoding="utf-8") as fh:
+        json.dump(meta, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def checkpoint_meta(prefix: str, epoch: int,
+                    dispatch: Optional[int] = None) -> Optional[Dict]:
+    """The topology sidecar of one checkpoint, or None (pre-graftheal
+    checkpoints / unreadable sidecar — resume then keeps the legacy
+    same-topology assumption, and says so)."""
+    path = os.path.join(os.path.abspath(prefix),
+                        checkpoint_name(epoch, dispatch), META_NAME)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError) as exc:
+        logger.warning("unreadable checkpoint meta at %s (%s); resume "
+                       "will assume the saving topology", path, exc)
+        return None
 
 
 def _map_bbox_pred(params, fn_kernel, fn_bias):
@@ -113,10 +149,9 @@ def _finalize(tmp: str, final: str):
     grammar, deleted only after the new dir is in place), so the
     no-checkpoint window is two renames, not an rmtree. A kill between
     them leaves ``.old`` as a manually recoverable copy."""
-    c = chaos.from_env()
     # chaos site "checkpoint_finalize": the crash-window test SIGKILLs
     # here — after the full write, before publication (test_resilience).
-    c.maybe_die("checkpoint_finalize")
+    chaos.site("checkpoint_finalize")
     old = final + ".old"
     if os.path.isdir(final):
         if os.path.isdir(old):
@@ -124,7 +159,7 @@ def _finalize(tmp: str, final: str):
         os.replace(final, old)
         # chaos site "checkpoint_swap": previous checkpoint set aside,
         # new one not yet published — the narrowest crash window.
-        c.maybe_die("checkpoint_swap")
+        chaos.site("checkpoint_swap")
     os.replace(tmp, final)
     if os.path.isdir(old):  # ours, or an orphan of a crashed predecessor
         shutil.rmtree(old)
@@ -163,7 +198,8 @@ def _sweep_stale_tmps(prefix: str):
 def save_checkpoint(prefix: str, epoch: int, params, opt_state=None, *,
                     means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2),
                     num_classes: Optional[int] = None,
-                    dispatch: Optional[int] = None):
+                    dispatch: Optional[int] = None,
+                    meta: Optional[Dict[str, Any]] = None):
     """Save epoch checkpoint at <prefix>/<epoch>/ (raw-delta form).
 
     opt_state is saved alongside when given (the reference cannot resume
@@ -171,7 +207,9 @@ def save_checkpoint(prefix: str, epoch: int, params, opt_state=None, *,
     ``dispatch`` tags a graftguard mid-epoch emergency save (see
     checkpoint_name); the write lands in a ``*.tmp-*`` dir and is
     published by one atomic rename, so a kill mid-save leaves no
-    resumable-looking partial state.
+    resumable-looking partial state. ``meta`` (a small JSON-able dict —
+    the graftheal topology sidecar, see META_NAME) is written into the
+    tmp dir so it publishes atomically with the arrays.
     """
     path, to_save = _prepare_save(prefix, epoch, params, opt_state,
                                   means, stds, num_classes, dispatch)
@@ -179,6 +217,8 @@ def save_checkpoint(prefix: str, epoch: int, params, opt_state=None, *,
     ckptr = ocp.PyTreeCheckpointer()
     tmp = _tmp_path(path)
     ckptr.save(tmp, to_save, force=True)
+    if meta is not None:
+        _write_meta(tmp, meta)
     _finalize(tmp, path)
     logger.info("Saved checkpoint to %s", path)
     return path
@@ -201,22 +241,27 @@ class CheckpointWriter:
 
     def __init__(self):
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
-        # (tmp, final) of the in-flight save; published (renamed) only
-        # after orbax confirms the write finished — the same atomic
-        # crash-window guarantee as the sync path, deferred.
-        self._pending: Optional[Tuple[str, str]] = None
+        # (tmp, final, meta) of the in-flight save; published (renamed)
+        # only after orbax confirms the write finished — the same atomic
+        # crash-window guarantee as the sync path, deferred. The meta
+        # sidecar is written just before the rename (the background
+        # writer owns the tmp dir until then).
+        self._pending: Optional[Tuple[str, str, Optional[Dict]]] = None
 
     def _publish_pending(self):
         if self._pending is not None:
-            tmp, final = self._pending
+            tmp, final, meta = self._pending
             self._pending = None
+            if meta is not None:
+                _write_meta(tmp, meta)
             _finalize(tmp, final)
             logger.info("Checkpoint %s durable", final)
 
     def save(self, prefix: str, epoch: int, params, opt_state=None, *,
              means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2),
              num_classes: Optional[int] = None,
-             dispatch: Optional[int] = None):
+             dispatch: Optional[int] = None,
+             meta: Optional[Dict[str, Any]] = None):
         """Non-blocking analog of `save_checkpoint` — _prepare_save gives
         the identical on-disk form (host numpy; restores on any device
         topology); only the write is backgrounded. NOT durable on return:
@@ -231,7 +276,7 @@ class CheckpointWriter:
         _sweep_stale_tmps(prefix)
         tmp = _tmp_path(path)
         self._ckptr.save(tmp, to_save, force=True)
-        self._pending = (tmp, path)
+        self._pending = (tmp, path, meta)
         logger.info("Saving checkpoint to %s (async)", path)
         return path
 
@@ -344,22 +389,41 @@ def latest_epoch(prefix: str) -> Optional[int]:
 def latest_checkpoint(prefix: str) -> Optional[Tuple[int, Optional[int]]]:
     """The most-advanced resume point under prefix: ``(epoch, None)`` for
     an epoch-boundary checkpoint ("epoch" epochs complete) or
-    ``(epoch, dispatch)`` for a graftguard emergency save (mid-epoch
-    ``epoch``, ``dispatch`` dispatches complete). Progress orders as the
-    tuple: epoch save N ≡ (N, 0) sits between (N-1, d) emergencies and
-    any (N, d>0) emergency. Unfinished ``*.tmp-*`` writes never match the
-    name grammar, so a kill mid-save can never be resumed from."""
+    ``(epoch, dispatch)`` for an emergency save (mid-epoch ``epoch``,
+    ``dispatch`` dispatches complete — graftguard preemption or a
+    graftheal capture). Progress orders as the tuple: epoch save N ≡
+    (N, 0) sits between (N-1, d) emergencies and any (N, d>0) emergency.
+    An emergency save carrying the SAME progress as a boundary save
+    ("0003d00000" vs "0003" — a capture at dispatch 0) ties: the
+    emergency save wins DETERMINISTICALLY (it is the later artifact and
+    may carry a topology sidecar the boundary save predates), and the
+    choice is logged — never left to directory-listing order. Unfinished
+    ``*.tmp-*`` writes never match the name grammar, so a kill mid-save
+    can never be resumed from."""
     if not os.path.isdir(prefix):
         return None
-    best = None
+    best = best_name = None
+    names = set()
     for d in os.listdir(prefix):
         m = _CKPT_NAME_RE.match(d)
         if not m:
             continue
+        names.add(d)
         epoch, dispatch = int(m.group(1)), m.group(2)
-        key = (epoch, int(dispatch) if dispatch else 0)
+        # third element: emergency (dispatch-tagged) outranks an
+        # epoch-boundary save at equal progress — the deterministic
+        # tie-break (strict > keeps the first listing otherwise).
+        key = (epoch, int(dispatch) if dispatch is not None else 0,
+               1 if dispatch is not None else 0)
         if best is None or key > best:
-            best = key
+            best, best_name = key, d
     if best is None:
         return None
-    return best[0], (best[1] or None)
+    epoch, dispatch, emergency = best
+    if emergency and dispatch == 0 and checkpoint_name(epoch) in names:
+        logger.info(
+            "resume tie at epoch %d: emergency save %s and boundary save "
+            "%s carry the same progress — picking the emergency save "
+            "(deterministic tie-break)", epoch, best_name,
+            checkpoint_name(epoch))
+    return epoch, (dispatch if emergency else None)

@@ -46,6 +46,21 @@ class MetricBag:
                     self._counts[n] += 1
         self._pending = []
 
+    def snapshot(self):
+        """Drained (sums, counts) as host floats — the graftheal carry:
+        captured with the train state so a healed mid-epoch resume keeps
+        accounting for the pre-loss dispatches (and a snapshot-rollback
+        replay re-adds exactly the dispatches it replays)."""
+        self._drain()
+        return dict(self._sums), dict(self._counts)
+
+    def restore(self, snap):
+        """Inverse of snapshot() onto a fresh bag."""
+        sums, counts = snap
+        self._pending = []
+        self._sums = {n: float(sums.get(n, 0.0)) for n in self.names}
+        self._counts = {n: int(counts.get(n, 0)) for n in self.names}
+
     def get(self) -> Dict[str, float]:
         """Per-slot running means of the metrics ACTUALLY SEEN — each slot
         averages over the updates that carried it (the reference

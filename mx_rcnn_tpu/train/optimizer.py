@@ -139,6 +139,30 @@ def build_optimizer(cfg: Config, params, steps_per_epoch: int = 1000,
         {"train": inner, "frozen": optax.set_to_zero()}, labels)
 
 
+def rebase_schedule_count(opt_state, step: int):
+    """Rewrite every scalar integer count leaf of an optax state to
+    ``step`` (host-side; returns a new tree).
+
+    Elastic cross-topology resume (graftheal): a restored opt_state's
+    schedule/Adam counters are in the SAVING run's optimizer-step units.
+    Once the dispatch skip has been converted through the images-consumed
+    invariant, this run counts steps in its OWN units (its
+    steps_per_epoch, its LR schedule) — left unrebased, every schedule
+    read (warmup/decay boundaries) would happen at the old run's
+    position, silently bending the LR trajectory. Scalar integer leaves
+    are exactly optax's counts (the same invariant flatcore's slot
+    discovery keys on)."""
+    import numpy as np
+
+    def _fix(leaf):
+        arr = np.asarray(leaf)
+        if arr.ndim == 0 and np.issubdtype(arr.dtype, np.integer):
+            return np.asarray(step, arr.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(_fix, opt_state)
+
+
 # ---------------------------------------------------------------------------
 # Flat update path (train/flatcore.py storage). The r4 probes showed the
 # ~6 ms update floor is a serialization cost of launching hundreds of

@@ -1,10 +1,15 @@
 #!/usr/bin/env bash
-# graftguard chaos gate — the fault-injection subset of tier-1 on CPU:
-# injected UNAVAILABLE outages, SIGTERM preemption + kill->resume parity,
-# hung-bench deadline isolation, and the checkpoint crash window
-# (tests/test_resilience.py; runbook OUTAGES.md). Every failure mode the
-# round-5 outage demonstrated, exercised on demand instead of by the next
-# real outage. Same invocation locally and in any future CI.
+# graftguard + graftheal chaos gate — the fault-injection subset of tier-1
+# on CPU: injected UNAVAILABLE outages, SIGTERM preemption + kill->resume
+# parity, hung-bench deadline isolation, the checkpoint crash window
+# (tests/test_resilience.py), and the graftheal matrix — mid-run device
+# loss with heal-and-continue bit-exact parity (tree AND flat), double
+# loss inside one heal window, elastic 8->4 shrink with loss-trajectory
+# agreement, and cross-topology resume via the checkpoint meta sidecar
+# (tests/test_heal.py). Runbook: OUTAGES.md. Every failure mode the
+# round-5 outage demonstrated (and the mid-run one it implied), exercised
+# on demand instead of by the next real outage. Same invocation locally
+# and in any future CI.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu exec python -m pytest -m chaos "$@"

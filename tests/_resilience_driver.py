@@ -84,18 +84,27 @@ def tiny_config(flat: bool = False, obs_dir: str = ""):
 
 
 def run_fit(prefix: str, end_epoch: int = 2, resume=False,
-            flat: bool = False, obs_dir: str = ""):
-    """3 images x 64^2, seed 0, mesh "1" — returns the final host params.
-    Deterministic end to end, so an interrupted+resumed run must match an
-    uninterrupted one bit for bit."""
+            flat: bool = False, obs_dir: str = "", mesh: str = "1",
+            num_images: int = 3, epoch_metrics=None):
+    """num_images x 64^2, seed 0 — returns the final host params.
+    Deterministic end to end, so an interrupted+resumed (or graftheal-ed)
+    run must match an uninterrupted one bit for bit. ``mesh`` sizes the
+    data axis (the heal shrink gates run "8" on the virtual CPU mesh);
+    ``epoch_metrics`` (a list) collects ``(epoch, bag.get())`` per epoch —
+    the loss trajectory the elastic gates compare."""
     from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
     from mx_rcnn_tpu.tools.train import fit_detector
 
-    ds = SyntheticDataset("train", num_images=3, image_size=64,
+    ds = SyntheticDataset("train", num_images=num_images, image_size=64,
                           max_objects=1, min_size_frac=3, max_size_frac=2)
+    cb = None
+    if epoch_metrics is not None:
+        def cb(epoch, state, bag):
+            epoch_metrics.append((epoch, bag.get()))
     return fit_detector(tiny_config(flat, obs_dir), ds.gt_roidb(),
                         prefix=prefix, end_epoch=end_epoch, frequent=1000,
-                        seed=0, mesh_spec="1", resume=resume)
+                        seed=0, mesh_spec=mesh, resume=resume,
+                        epoch_callback=cb)
 
 
 def _crash_save(prefix: str, scale: float = 1.0):
@@ -121,11 +130,21 @@ def main(argv=None):
     p.add_argument("--flat", action="store_true",
                    help="train.flat_params=true mode")
     p.add_argument("--obs-dir", default="")
+    p.add_argument("--mesh", default="1", help="mesh spec (data[xmodel])")
+    p.add_argument("--num-images", type=int, default=3)
     p.add_argument("--crash-save", metavar="PREFIX",
                    help="one sync checkpoint save (the crash-window probe)")
     p.add_argument("--scale", type=float, default=1.0,
                    help="scale factor on the --crash-save tree")
     args = p.parse_args(argv)
+
+    if args.mesh not in ("", "1", "1x1"):
+        # Multi-device mesh in a subprocess: the virtual CPU devices must
+        # be requested BEFORE jax initializes (same dance as conftest.py).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
 
     import jax
 
@@ -139,7 +158,8 @@ def main(argv=None):
         return 0
     if args.fit:
         run_fit(args.fit, end_epoch=args.end_epoch, resume=args.resume,
-                flat=args.flat, obs_dir=args.obs_dir)
+                flat=args.flat, obs_dir=args.obs_dir, mesh=args.mesh,
+                num_images=args.num_images)
         return 0
     p.error("one of --fit / --crash-save is required")
 

@@ -869,3 +869,43 @@ def test_retry_near_miss_poll_loop_without_handler():
                 time.sleep(0.5)
     """)
     assert "unbounded-retry" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# chaos-site-name
+# ---------------------------------------------------------------------------
+
+def test_chaos_site_flags_typoed_site():
+    findings = lint("""
+        from mx_rcnn_tpu.resilience import chaos
+
+        def publish(tmp, final):
+            chaos.site("checkpoint_finalze")   # typo: never fires
+    """)
+    assert "chaos-site-name" in rules_of(findings)
+    msg = next(f for f in findings if f.rule == "chaos-site-name").message
+    assert "unregistered chaos site 'checkpoint_finalze'" in msg
+
+
+def test_chaos_site_flags_non_literal_and_missing_name():
+    findings = lint("""
+        def loop(chaos_spec, where):
+            chaos_spec.fire(where, step=3)
+            chaos_spec.maybe_die()
+    """)
+    assert sum(f.rule == "chaos-site-name" for f in findings) == 2
+
+
+def test_chaos_site_near_miss_registered_and_foreign_receivers():
+    findings = lint("""
+        from mx_rcnn_tpu.resilience import chaos
+
+        def run(chaos_spec, laser, evt):
+            chaos.site("checkpoint_finalize")
+            chaos.site("backend_reacquire", devices=[1, 2])
+            chaos_spec.fire("train_dispatch", step=3)
+            chaos_spec.maybe_die("checkpoint_swap")
+            laser.fire(evt)          # foreign receiver — out of scope
+            laser.site("anywhere")   # ditto
+    """)
+    assert "chaos-site-name" not in rules_of(findings)

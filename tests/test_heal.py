@@ -1,0 +1,650 @@
+"""graftheal (mx_rcnn_tpu/resilience/heal.py) gates — mid-run backend loss.
+
+graftguard (tests/test_resilience.py) pinned startup acquisition and
+preemption; these gates pin the failure that still killed a run dead: the
+backend dying MID-STEP, hours in. Every scenario is injected
+deterministically (resilience/chaos.py) on the virtual 8-device CPU mesh
+and must be survived IN-PROCESS — no crash, no operator:
+
+- device loss at step K: the run completes on its own and its final
+  params are BIT-exact (f32 CPU) vs an uninterrupted run — tree AND
+  ``train.flat_params=true`` storage modes;
+- double loss inside one heal window: the re-dispatch fails again and the
+  second heal also succeeds (the consecutive-heal cap has headroom);
+- elastic shrink: the backend comes back with 4 of 8 devices — the mesh
+  is re-cut with the GLOBAL batch invariant, and the loss trajectory
+  matches the uninterrupted 8-device run within the existing DP parity
+  tolerances (psum reassociation only), both storage modes;
+- elastic resume across topologies: an emergency save cut on 8 devices
+  resumes on a 4-device mesh — the checkpoint meta sidecar converts the
+  dispatch skip through the images-consumed invariant.
+
+All tests carry the ``chaos`` marker (script/smoke_resilience.sh runs the
+subset); tier-1, NOT slow.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import ResilienceConfig
+from mx_rcnn_tpu.obs import open_event_log, report
+from mx_rcnn_tpu.obs.events import EventLog, NullEventLog
+from mx_rcnn_tpu.obs.watchdog import StallWatchdog
+from mx_rcnn_tpu.parallel.partition import elastic_mesh_spec
+from mx_rcnn_tpu.resilience import (
+    RESUMABLE_RC,
+    HealCarry,
+    Healer,
+    PreemptionExit,
+    chaos,
+)
+from mx_rcnn_tpu.resilience import heal as heal_mod
+from mx_rcnn_tpu.train.checkpoint import (
+    checkpoint_meta,
+    latest_checkpoint,
+    latest_epoch,
+    save_checkpoint,
+)
+from mx_rcnn_tpu.train.metrics import MetricBag
+
+import _resilience_driver as driver
+
+pytestmark = pytest.mark.chaos
+
+#: the existing DP split-parity tolerances (tests/test_train_step.py):
+#: regrouping the psum over fewer devices reassociates float sums.
+LOSS_RTOL = 1e-4
+PARAM_RTOL, PARAM_ATOL = 2e-3, 2e-5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos(monkeypatch):
+    """No injection leaks between tests (or in from the outer env)."""
+    monkeypatch.delenv(chaos.ENV_VAR, raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _assert_trees_bitexact(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = {jax.tree_util.keystr(p): v
+          for p, v in jax.tree_util.tree_leaves_with_path(b)}
+    assert len(la) == len(lb)
+    for path, va in la:
+        np.testing.assert_array_equal(
+            np.asarray(va), np.asarray(lb[jax.tree_util.keystr(path)]),
+            err_msg=jax.tree_util.keystr(path))
+
+
+def _assert_trees_close(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = {jax.tree_util.keystr(p): v
+          for p, v in jax.tree_util.tree_leaves_with_path(b)}
+    for path, va in la:
+        np.testing.assert_allclose(
+            np.asarray(va), np.asarray(lb[jax.tree_util.keystr(path)]),
+            rtol=PARAM_RTOL, atol=PARAM_ATOL,
+            err_msg=jax.tree_util.keystr(path))
+
+
+# ---------------------------------------------------------------------------
+# chaos spec: the new keys
+# ---------------------------------------------------------------------------
+
+def test_chaos_parse_heal_keys():
+    spec = chaos.parse("device_lost_at_step=4 device_lost_count=2 "
+                       "shrink_on_reacquire=4")
+    assert spec.device_lost_at_step == 4 and spec.device_lost_count == 2
+    assert spec.shrink_on_reacquire == 4 and spec.active
+
+
+def test_chaos_device_loss_fires_armed_count_then_stops():
+    spec = chaos.parse("device_lost_at_step=4 device_lost_count=2")
+    spec.maybe_device_loss(3)  # below threshold: nothing
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        spec.maybe_device_loss(4)
+    with pytest.raises(RuntimeError, match="2/2"):
+        spec.maybe_device_loss(4)
+    spec.maybe_device_loss(4)  # count spent: the backend stays up
+    assert spec.maybe_shrink(list(range(8))) == list(range(8))
+    assert chaos.parse("shrink_on_reacquire=4").maybe_shrink(
+        list(range(8))) == [0, 1, 2, 3]
+
+
+def test_chaos_die_at_site_must_be_registered():
+    """A typo'd die_at site would arm an injection that can never fire —
+    the same silent-un-testing hazard the unknown-key check closes."""
+    with pytest.raises(ValueError, match="die_at site"):
+        chaos.parse("die_at=checkpoint_finalze")
+    assert chaos.parse("die_at=checkpoint_swap").die_at == "checkpoint_swap"
+
+
+def test_chaos_die_at_fires_at_every_registered_site(monkeypatch):
+    """parse() accepts any member of SITES for die_at, so fire() must
+    route maybe_die at EVERY site — a validated-but-unroutable site
+    would be exactly the armed-never-fires hole the validation closes."""
+    import signal as _signal
+
+    for site_name in sorted(chaos.SITES):
+        calls = []
+        monkeypatch.setattr(chaos.os, "kill",
+                            lambda pid, sig: calls.append(sig))
+        spec = chaos.parse(f"die_at={site_name}")
+        fire = spec.fire  # aliased: the site name is a loop VARIABLE
+        fire(site_name, step=10_000, devices=["d0"])
+        assert calls == [_signal.SIGKILL], site_name
+
+
+# ---------------------------------------------------------------------------
+# elastic mesh re-derivation (parallel/partition.py)
+# ---------------------------------------------------------------------------
+
+def test_elastic_mesh_spec_shrinks_data_axis():
+    # same-or-more devices: keep the footprint (growth is not a recovery)
+    assert elastic_mesh_spec(8, 1, 8, 8) == "8x1"
+    assert elastic_mesh_spec(8, 1, 16, 8) == "8x1"
+    # the acceptance shrink: 8 -> 4, batch 8 divides
+    assert elastic_mesh_spec(8, 1, 4, 8) == "4x1"
+    # non-dividing counts drop to the largest batch divisor
+    assert elastic_mesh_spec(8, 1, 3, 8) == "2x1"
+    assert elastic_mesh_spec(8, 1, 1, 8) == "1x1"
+    # model axis is preserved; data shrinks within what remains
+    assert elastic_mesh_spec(4, 2, 4, 8) == "2x2"
+    with pytest.raises(ValueError, match="model axis"):
+        elastic_mesh_spec(4, 2, 1, 8)
+
+
+# ---------------------------------------------------------------------------
+# Healer unit behavior (hermetic: acquisition/teardown monkeypatched)
+# ---------------------------------------------------------------------------
+
+def _hermetic_healer(monkeypatch, tmp_path=None, devices=("d0", "d1"),
+                     **rcfg_kw):
+    monkeypatch.setattr(heal_mod, "_clear_backend_cache", lambda: None)
+    monkeypatch.setattr(heal_mod, "acquire_backend",
+                        lambda rcfg, elog=None: list(devices))
+    elog = open_event_log(str(tmp_path)) if tmp_path is not None else None
+    rcfg = ResilienceConfig(**rcfg_kw)
+    return Healer(rcfg, elog=elog), elog
+
+
+def test_healer_classifies_with_pr5_taxonomy(monkeypatch):
+    healer, _ = _hermetic_healer(monkeypatch)
+    assert healer.healable(RuntimeError("UNAVAILABLE: device lost"))
+    assert healer.healable(RuntimeError("ABORTED: relay restarting"))
+    assert not healer.healable(RuntimeError("INVALID_ARGUMENT: shapes"))
+    assert not healer.healable(ValueError("UNAVAILABLE-looking non-RT"))
+    assert not healer.healable(
+        RuntimeError("XlaRuntimeError: something unclassified"))
+    off, _ = _hermetic_healer(monkeypatch, heal=False)
+    assert not off.healable(RuntimeError("UNAVAILABLE: device lost"))
+
+
+def test_healer_live_capture_and_event(monkeypatch, tmp_path):
+    healer, elog = _hermetic_healer(monkeypatch, tmp_path)
+    healer.note_devices(2)
+    carry = HealCarry(params={"w": np.ones(3)}, opt_state=None,
+                      epoch=2, dispatch=5)
+    got = healer.recover(RuntimeError("UNAVAILABLE: gone"), lambda: carry)
+    elog.close()
+    assert got is carry and healer.devices == ["d0", "d1"]
+    assert healer.heals == 1
+    (ev,) = [e for e in report.load_events(str(tmp_path))
+             if e["type"] == "heal"]
+    assert ev["mode"] == "live" and ev["epoch"] == 2 and ev["dispatch"] == 5
+    assert ev["devices_before"] == 2 and ev["devices_after"] == 2
+
+
+def test_healer_regrow_reports_against_footprint(monkeypatch, tmp_path):
+    """After an 8->4 shrink, a later heal that recovers the full backend
+    must report the 4->8 RE-GROW — capping at the previous (shrunken)
+    session's size would log 4->4 and hide the transition."""
+    healer, elog = _hermetic_healer(monkeypatch, tmp_path,
+                                    devices=("a", "b", "c", "d"))
+    carry = HealCarry(params={})
+    healer.note_devices(8)  # nominal footprint
+    healer.recover(RuntimeError("UNAVAILABLE: lost"), lambda: carry)
+    healer.note_devices(4)  # the shrunken session
+    healer.note_progress()
+    monkeypatch.setattr(heal_mod, "acquire_backend",
+                        lambda rcfg, elog=None: list("abcdefgh"))
+    healer.recover(RuntimeError("UNAVAILABLE: again"), lambda: carry)
+    elog.close()
+    evs = [e for e in report.load_events(str(tmp_path))
+           if e["type"] == "heal"]
+    assert [(e["devices_before"], e["devices_after"])
+            for e in evs] == [(8, 4), (4, 8)]
+
+
+def test_healer_capture_failure_falls_back_to_snapshot(monkeypatch,
+                                                       tmp_path):
+    healer, elog = _hermetic_healer(monkeypatch, tmp_path)
+    snap = HealCarry(params={"w": np.zeros(3)}, opt_state=None,
+                     epoch=1, dispatch=7)
+    healer.set_fallback(snap)
+
+    def bad_capture():
+        raise RuntimeError("device_get on a dead backend")
+
+    got = healer.recover(RuntimeError("UNAVAILABLE: gone"), bad_capture)
+    elog.close()
+    assert got is snap
+    (ev,) = [e for e in report.load_events(str(tmp_path))
+             if e["type"] == "heal"]
+    assert ev["mode"] == "snapshot" and ev["dispatch"] == 7
+
+
+def test_healer_no_capture_no_fallback_reraises(monkeypatch):
+    healer, _ = _hermetic_healer(monkeypatch)
+    boom = RuntimeError("UNAVAILABLE: gone")
+
+    def bad_capture():
+        raise RuntimeError("unreadable")
+
+    with pytest.raises(RuntimeError, match="UNAVAILABLE") as ei:
+        healer.recover(boom, bad_capture)
+    assert ei.value is boom
+
+
+def test_healer_consecutive_cap_and_progress_rearm(monkeypatch):
+    healer, _ = _hermetic_healer(monkeypatch, heal_consecutive_max=2)
+    carry = HealCarry(params={})
+    loss = RuntimeError("UNAVAILABLE: gone")
+    for _ in range(2):
+        assert healer.healable(loss)
+        healer.recover(loss, lambda: carry)
+    # two consecutive heals with no completed dispatch: give up...
+    assert not healer.healable(loss)
+    # ...unless progress happened in between — then the cap re-arms
+    healer.note_progress()
+    assert healer.healable(loss)
+
+
+def test_healer_snapshot_cadence():
+    healer = Healer(ResilienceConfig(heal_snapshot_dispatches=3))
+    due = [healer.snapshot_due() for _ in range(7)]
+    assert due == [False, False, True, False, False, True, False]
+    assert not any(Healer(ResilienceConfig(heal_snapshot_dispatches=0))
+                   .snapshot_due() for _ in range(5))
+
+
+# ---------------------------------------------------------------------------
+# StallWatchdog.reset after a heal (satellite fix)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_reset_forgets_trailing_median():
+    """After a heal the first step pays re-acquisition + a fresh compile;
+    judged by the pre-loss median it would read as a stall. reset() must
+    re-arm with cold-start grace instead."""
+    wd = StallWatchdog(NullEventLog(), stall_factor=10.0, min_stall_s=0.05,
+                       poll_s=60.0)
+    for _ in range(20):
+        wd.beat(0.01)  # fast steady state: threshold = 10 x 0.01 = 0.1s
+    assert wd.threshold_s() == pytest.approx(0.1)
+    import time
+
+    with wd._lock:  # simulate 1s without a heartbeat (the heal window)
+        wd._last_beat = time.monotonic() - 1.0
+    assert wd.check()  # without reset: a (false) stall fires
+    with wd._lock:
+        wd._last_beat = time.monotonic() - 1.0
+    wd.reset()
+    # post-reset: no durations -> COLD_GRACE x min_stall_s, and the
+    # heal-window gap was forgotten with the beat refresh
+    assert wd.threshold_s() == pytest.approx(
+        StallWatchdog.COLD_GRACE * 0.05)
+    assert not wd.check()
+    # pause() silences the tripwire for the heal window itself (which
+    # can outlast ANY threshold while acquire_backend backs off) and is
+    # lifted by reset()/beat()
+    wd.pause()
+    with wd._lock:
+        wd._last_beat = time.monotonic() - 3600.0
+    assert not wd.check()
+    wd.reset()
+    assert not wd.check()  # reset also refreshed the beat
+    wd.pause()
+    wd.beat(0.01)
+    with wd._lock:
+        wd._last_beat = time.monotonic() - 3600.0
+    assert wd.check()  # a real heartbeat lifted the pause
+
+
+def test_healer_pauses_watchdog_for_the_heal_window(monkeypatch):
+    """recover() must pause BEFORE capture/re-acquisition — a backend
+    outage longer than the stall threshold would otherwise fire a false
+    stall dump mid-heal, before the post-heal reset ran."""
+    events = []
+
+    class _WD:
+        def pause(self):
+            events.append("pause")
+
+        def reset(self):
+            events.append("reset")
+
+    monkeypatch.setattr(heal_mod, "_clear_backend_cache",
+                        lambda: events.append("teardown"))
+    monkeypatch.setattr(heal_mod, "acquire_backend",
+                        lambda rcfg, elog=None: (events.append("acquire")
+                                                 or ["d0"]))
+    healer = Healer(ResilienceConfig(), watchdog=_WD())
+    healer.recover(RuntimeError("UNAVAILABLE: gone"),
+                   lambda: HealCarry(params={}))
+    assert events == ["pause", "teardown", "acquire", "reset"]
+
+
+# ---------------------------------------------------------------------------
+# MetricBag carry (the healed epoch keeps pre-loss accounting)
+# ---------------------------------------------------------------------------
+
+def test_metric_bag_snapshot_restore_roundtrip():
+    bag = MetricBag()
+    bag.update({"TotalLoss": 2.0, "RPNAcc": 0.5})
+    bag.update({"TotalLoss": 4.0})
+    snap = bag.snapshot()
+    other = MetricBag()
+    other.restore(snap)
+    other.update({"TotalLoss": 6.0})
+    got = other.get()
+    assert got["TotalLoss"] == pytest.approx(4.0)  # (2+4+6)/3
+    assert got["RPNAcc"] == pytest.approx(0.5)
+    assert "RCNNAcc" not in got  # never-seen slots stay omitted
+
+
+def test_rebase_schedule_count_rewrites_integer_scalars_only():
+    """Elastic resume: restored optax counters are in the saving run's
+    step units — rebase must rewrite exactly the scalar integer leaves
+    (optax's counts) and leave slots/params untouched."""
+    import optax
+
+    from mx_rcnn_tpu.train.optimizer import rebase_schedule_count
+
+    tx = optax.chain(optax.clip(1.0),
+                     optax.sgd(optax.linear_schedule(0.1, 0.0, 100),
+                               momentum=0.9))
+    params = {"w": np.ones(3, np.float32)}
+    opt = tx.init(params)
+    # advance the counter to the "old units" position
+    for _ in range(3):
+        _, opt = tx.update({"w": np.ones(3, np.float32)}, opt, params)
+    rebased = rebase_schedule_count(opt, 6)
+    import jax
+
+    counts = [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(
+        rebased) if np.asarray(leaf).ndim == 0
+        and np.issubdtype(np.asarray(leaf).dtype, np.integer)]
+    assert counts and all(int(c) == 6 for c in counts)
+    # non-count leaves (momentum trace) survive untouched
+    trace = [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(
+        rebased) if np.asarray(leaf).shape == (3,)]
+    assert trace and not np.allclose(trace[0], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# latest_checkpoint tie-break (satellite fix)
+# ---------------------------------------------------------------------------
+
+def test_latest_checkpoint_tie_break_emergency_wins(tmp_path, caplog):
+    """"0003" (boundary) and "0003d00000" (emergency at dispatch 0) carry
+    the SAME progress: the emergency save must win deterministically —
+    and be loadable (the old code collapsed the tie to the boundary name
+    by dict-order luck, crashing when only the emergency dir existed)."""
+    (tmp_path / "0003d00000").mkdir()
+    assert latest_checkpoint(str(tmp_path)) == (3, 0)  # alone: emergency
+    (tmp_path / "0003").mkdir()
+    import logging
+
+    with caplog.at_level(logging.INFO):
+        assert latest_checkpoint(str(tmp_path)) == (3, 0)
+    assert any("tie" in r.message for r in caplog.records)
+    # ordering around the tie is unchanged
+    (tmp_path / "0003d00001").mkdir()
+    assert latest_checkpoint(str(tmp_path)) == (3, 1)
+    (tmp_path / "0004").mkdir()
+    assert latest_checkpoint(str(tmp_path)) == (4, None)
+    assert latest_epoch(str(tmp_path)) == 4
+
+
+# ---------------------------------------------------------------------------
+# checkpoint meta sidecar (the elastic axis of the tree-form contract)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_meta_roundtrip_sync_and_async(tmp_path):
+    from mx_rcnn_tpu.train.checkpoint import CheckpointWriter, load_checkpoint
+
+    tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    meta = {"images_per_dispatch": 8, "device_count": 8,
+            "epoch": 1, "dispatch": 2}
+    prefix = str(tmp_path / "ck")
+    save_checkpoint(prefix, 1, tree, dispatch=2, meta=meta)
+    assert checkpoint_meta(prefix, 1, 2) == meta
+    assert checkpoint_meta(prefix, 1) is None  # no such checkpoint
+    # the sidecar does not disturb the array restore
+    loaded, _ = load_checkpoint(prefix, 1, dispatch=2,
+                                template={"w": np.zeros_like(tree["w"])})
+    np.testing.assert_array_equal(loaded["w"], tree["w"])
+
+    writer = CheckpointWriter()
+    try:
+        writer.save(prefix, 2, tree, meta={"images_per_dispatch": 4})
+    finally:
+        writer.close()  # publishes: meta lands with the rename
+    assert checkpoint_meta(prefix, 2) == {"images_per_dispatch": 4}
+    # pre-graftheal checkpoints (no sidecar) read as None, not an error
+    save_checkpoint(prefix, 3, tree)
+    assert checkpoint_meta(prefix, 3) is None
+
+
+# ---------------------------------------------------------------------------
+# obs.report fold
+# ---------------------------------------------------------------------------
+
+def test_report_folds_heal_events(tmp_path):
+    elog = open_event_log(str(tmp_path))
+    elog.emit("heal", epoch=0, dispatch=2, error="UNAVAILABLE: gone",
+              mode="live", downtime_s=3.5, devices_before=8,
+              devices_after=8)
+    elog.emit("heal", epoch=1, dispatch=0, error="UNAVAILABLE: again",
+              mode="snapshot", downtime_s=1.5, devices_before=8,
+              devices_after=4)
+    elog.close()
+    summary = report.summarize(report.load_events(str(tmp_path)))
+    assert summary["heals"]["count"] == 2
+    assert summary["heals"]["downtime_s"] == pytest.approx(5.0)
+    assert summary["heals"]["shrinks"] == ["8->4"]
+    assert "again" in summary["heals"]["last_error"]
+    assert report.bench_blob(summary)["heal_count"] == 2
+    assert "heal:       2 in-run recover(ies)" in report.render(summary)
+    assert "shrink 8->4" in report.render(summary)
+
+
+def test_heal_event_type_is_schema_legal(tmp_path):
+    elog = EventLog(str(tmp_path / "e.jsonl"))
+    elog.emit("heal", downtime_s=1.0)  # raises if the schema missed it
+    elog.close()
+
+
+# ---------------------------------------------------------------------------
+# the chaos matrix: device loss at step K, heal-and-continue parity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tree_baseline(tmp_path_factory):
+    """The uninterrupted mesh-1 run every device-loss gate compares
+    against — computed once per module (bit-deterministic, so sharing
+    costs nothing and saves a full fit per test). The FLAT gates compare
+    against it too: flat storage is bit-exact vs the tree chain for this
+    SGD config (the PR 4 claim, gated in tests/test_flatcore.py), so one
+    baseline serves both modes — and a flat heal matching the TREE
+    baseline pins recovery and interchange at once."""
+    tmp = tmp_path_factory.mktemp("heal_baseline")
+    old = os.environ.pop(chaos.ENV_VAR, None)  # module scope sets up
+    chaos.reset()                              # before the autouse fixture
+    try:
+        return driver.run_fit(str(tmp / "u"), flat=False)
+    finally:
+        if old is not None:
+            os.environ[chaos.ENV_VAR] = old
+
+
+@pytest.fixture(scope="module")
+def mesh8_baseline(tmp_path_factory):
+    """Uninterrupted mesh-8 run (tree): (params, per-epoch metrics) —
+    shared by both shrink parametrizations (same flat≡tree argument as
+    tree_baseline)."""
+    tmp = tmp_path_factory.mktemp("heal_baseline8")
+    old = os.environ.pop(chaos.ENV_VAR, None)
+    chaos.reset()
+    try:
+        metrics = []
+        params = driver.run_fit(str(tmp / "u"), mesh="8", num_images=8,
+                                epoch_metrics=metrics)
+        return params, metrics
+    finally:
+        if old is not None:
+            os.environ[chaos.ENV_VAR] = old
+
+
+def _heal_run(tmp_path, monkeypatch, flat, spec, expect_heals):
+    """Run fit under the armed chaos spec: it must complete WITHOUT
+    operator intervention (no exception, no restart, no crash event),
+    emitting one `heal` event per injected loss. Returns (params, heals)."""
+    monkeypatch.setenv(chaos.ENV_VAR, spec)
+    chaos.reset()
+    obs_dir = str(tmp_path / "obs_healed")
+    params_h = driver.run_fit(str(tmp_path / "healed"), flat=flat,
+                              obs_dir=obs_dir)
+    events = report.load_events(obs_dir)
+    heals = [e for e in events if e["type"] == "heal"]
+    assert len(heals) == expect_heals, heals
+    assert all(e["mode"] == "live" for e in heals)
+    assert [e["type"] for e in events].count("crash") == 0
+    return params_h, heals
+
+
+@pytest.mark.compile_heavy
+def test_heal_device_loss_double_loss_parity_tree(tmp_path, monkeypatch,
+                                                  tree_baseline):
+    """Device loss at step K, tree mode — armed to fire TWICE: the
+    re-dispatch after the first heal fails again (double loss inside one
+    heal window), the second heal also succeeds (the consecutive cap,
+    default 3, has headroom), and the run still completes bit-exact.
+    Strictly covers the single-loss case (which shrink[tree] below also
+    exercises on the 8-wide mesh)."""
+    params_h, heals = _heal_run(
+        tmp_path, monkeypatch, flat=False,
+        spec="device_lost_at_step=4 device_lost_count=2", expect_heals=2)
+    _assert_trees_bitexact(tree_baseline, params_h)
+    # loss fired before the dispatch completing step 4 (epoch 1 of 2x3,
+    # dispatch 0): both captures are the last known-good position
+    assert [(e["epoch"], e["dispatch"]) for e in heals] == [(1, 0), (1, 0)]
+
+
+@pytest.mark.compile_heavy
+def test_heal_device_loss_parity_flat(tmp_path, monkeypatch, tree_baseline):
+    """Flat storage: the capture is TREE-form (FlatCore.tree_state) and
+    the healed session re-cuts the buffers via the SegmentTable — still
+    bit-exact vs the uninterrupted baseline (tree-mode; see the fixture:
+    flat≡tree is the separately-gated PR 4 claim)."""
+    params_h, _ = _heal_run(tmp_path, monkeypatch, flat=True,
+                            spec="device_lost_at_step=4", expect_heals=1)
+    _assert_trees_bitexact(tree_baseline, params_h)
+
+
+# ---------------------------------------------------------------------------
+# elastic shrink: 8 -> 4 virtual devices, global batch invariant
+# ---------------------------------------------------------------------------
+
+@pytest.mark.compile_heavy
+@pytest.mark.parametrize("flat", [False, True], ids=["tree", "flat"])
+def test_heal_shrink_8_to_4_loss_trajectory(tmp_path, monkeypatch, flat,
+                                            mesh8_baseline):
+    """The backend returns with half the devices: the mesh is re-cut
+    4x1, each survivor carries 2 batch rows, and the loss trajectory
+    matches the uninterrupted 8-device run within the existing DP parity
+    tolerances (the only difference is psum reassociation)."""
+    params_u, metrics_u = mesh8_baseline
+
+    monkeypatch.setenv(chaos.ENV_VAR,
+                       "device_lost_at_step=2 shrink_on_reacquire=4")
+    chaos.reset()
+    metrics_h = []
+    obs_dir = str(tmp_path / "obs_shrunk")
+    params_h = driver.run_fit(str(tmp_path / "shrunk"), mesh="8",
+                              num_images=8, flat=flat,
+                              epoch_metrics=metrics_h, obs_dir=obs_dir)
+
+    assert [e for e, _ in metrics_u] == [e for e, _ in metrics_h] == [0, 1]
+    for (_, mu), (_, mh) in zip(metrics_u, metrics_h):
+        for name, val in mu.items():
+            assert np.isclose(val, mh[name], rtol=LOSS_RTOL, atol=1e-6), (
+                name, val, mh[name])
+    _assert_trees_close(params_u, params_h)
+
+    (ev,) = [e for e in report.load_events(obs_dir) if e["type"] == "heal"]
+    assert ev["devices_before"] == 8 and ev["devices_after"] == 4
+    summary = report.summarize(report.load_events(obs_dir))
+    assert summary["heals"]["shrinks"] == ["8->4"]
+
+
+# ---------------------------------------------------------------------------
+# elastic resume: an emergency save cut on 8 devices resumes on 4
+# ---------------------------------------------------------------------------
+
+@pytest.mark.compile_heavy
+def test_elastic_resume_across_topologies(tmp_path, monkeypatch, caplog):
+    """The on-disk half of the elastic contract: a dispatch-tagged save
+    minted at 8 images/dispatch resumes on a 4-wide mesh — the meta
+    sidecar converts 1 old dispatch into 2 new ones, so the epoch's
+    trained prefix is skipped exactly (no image retrained or skipped)."""
+    prefix = str(tmp_path / "run")
+    monkeypatch.setenv(chaos.ENV_VAR, "sigterm_at_step=1")
+    chaos.reset()
+    with pytest.raises(PreemptionExit) as ei:
+        driver.run_fit(prefix, mesh="8", num_images=16, end_epoch=1)
+    assert ei.value.code == RESUMABLE_RC
+    assert latest_checkpoint(prefix) == (0, 1)
+    meta = checkpoint_meta(prefix, 0, 1)
+    assert meta["images_per_dispatch"] == 8
+    assert meta["device_count"] == 8 and meta["mesh"] == {"data": 8,
+                                                          "model": 1}
+
+    monkeypatch.delenv(chaos.ENV_VAR)
+    chaos.reset()
+    obs_dir = str(tmp_path / "obs_resumed")
+    driver.run_fit(prefix, mesh="4", num_images=16, end_epoch=1,
+                   resume="auto", obs_dir=obs_dir)
+    # new topology: 4 images/dispatch, 4 dispatches/epoch; the 8 trained
+    # images (1 old dispatch) become a 2-dispatch skip — telemetry shows
+    # epoch 0 resuming at dispatch 2, never re-emitting 0/1
+    resumed_e0 = sorted(e["batch"] for e in report.load_events(obs_dir)
+                        if e["type"] == "step" and e.get("epoch") == 0
+                        and "step_ms" in e)
+    assert resumed_e0 == [2, 3], resumed_e0
+    assert latest_epoch(prefix) == 1
+    assert checkpoint_meta(prefix, 1)["images_per_dispatch"] == 4
+
+    # Leg 3 — BOUNDARY checkpoint across topologies: resuming the
+    # 4-wide epoch-1 save back on the 8-wide mesh must also read the
+    # sidecar and rebase the optimizer counters (skip is 0, but the
+    # schedule units changed) — the gap the skip-only gating had.
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        driver.run_fit(prefix, mesh="8", num_images=16, end_epoch=2,
+                       resume="auto")
+    assert any("optimizer counters rebased to step 2" in r.message
+               for r in caplog.records), [r.message for r in caplog.records
+                                          if "rebase" in r.message]
+    assert latest_epoch(prefix) == 2
+    assert checkpoint_meta(prefix, 2)["images_per_dispatch"] == 8
+
+

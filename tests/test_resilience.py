@@ -93,11 +93,20 @@ def test_chaos_parse_roundtrip():
 def test_chaos_unset_is_inert():
     spec = chaos.from_env(environ={})
     assert not spec.active
-    # every hook is a no-op
+    # every hook is a no-op (site names must still be REGISTERED ones —
+    # the chaos-site-name lint rule holds for tests too)
     spec.maybe_fail_backend()
     spec.maybe_sigterm(10_000)
     spec.maybe_hang("anything")
-    spec.maybe_die("anywhere")
+    spec.maybe_die("checkpoint_finalize")
+    spec.maybe_device_loss(10_000)
+    assert spec.maybe_shrink(["d0", "d1"]) == ["d0", "d1"]
+    spec.fire("train_dispatch", step=10_000)
+    assert chaos.site("backend_reacquire",
+                      devices=["d0", "d1"]) == ["d0", "d1"]
+    fire = spec.fire  # aliased: exercising the RUNTIME check, not lint
+    with pytest.raises(ValueError, match="unregistered chaos site"):
+        fire("not_a_site")
 
 
 def test_chaos_rejects_unknown_key_and_bad_value():
