@@ -62,13 +62,15 @@ import numpy as np
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mx_rcnn_tpu.obs import compile_track
+from mx_rcnn_tpu.obs import costs as obs_costs
 from mx_rcnn_tpu.obs.events import _json_default
 from mx_rcnn_tpu.utils.compile_cache import enable_persistent_cache
 
 enable_persistent_cache()
 
 REFERENCE_IMG_S = 5.0  # estimated reference img/s/GPU (see module docstring)
-V5E_PEAK_FLOPS = 197e12  # bf16 peak per chip
+V5E_PEAK_FLOPS = obs_costs.V5E_PEAK_FLOPS  # bf16 peak per chip
 
 
 def make_batch(cfg):
@@ -76,14 +78,21 @@ def make_batch(cfg):
     h, w = cfg.image.pad_shape
     g = cfg.train.max_gt_boxes
     rs = np.random.RandomState(0)
-    n_boxes = 8
+    n_boxes = min(8, g)  # tiny tier-1 configs cap max_gt_boxes below 8
+    # Box span and im_info content size scale with the canvas so tiny
+    # tier-1 configs stay well-formed; at the flagship 640x1024 canvas
+    # these reduce EXACTLY to the historical constants (span 200, boxes
+    # uniform(50,199), content 600x1000 — rounds stay comparable). The
+    # content-vs-canvas gap is also the measured pad_waste baseline.
+    span = max(8, min(200, h // 2, w // 2))
+    content_h, content_w = h * 600 // 640, w * 1000 // 1024
     boxes = np.zeros((b, g, 4), np.float32)
     for i in range(b):
-        x1 = rs.uniform(0, w - 200, n_boxes)
-        y1 = rs.uniform(0, h - 200, n_boxes)
+        x1 = rs.uniform(0, w - span, n_boxes)
+        y1 = rs.uniform(0, h - span, n_boxes)
         boxes[i, :n_boxes] = np.stack(
-            [x1, y1, x1 + rs.uniform(50, 199, n_boxes),
-             y1 + rs.uniform(50, 199, n_boxes)], axis=1)
+            [x1, y1, x1 + rs.uniform(span // 4, span - 1, n_boxes),
+             y1 + rs.uniform(span // 4, span - 1, n_boxes)], axis=1)
     valid = np.zeros((b, g), bool)
     valid[:, :n_boxes] = True
     classes = np.zeros((b, g), np.int32)
@@ -91,7 +100,8 @@ def make_batch(cfg):
                                       (b, n_boxes))
     batch = {
         "image": rs.randn(b, h, w, 3).astype(np.float32),
-        "im_info": np.asarray([[600, 1000, 1.0]] * b, np.float32),
+        "im_info": np.asarray([[content_h, content_w, 1.0]] * b,
+                              np.float32),
         "gt_boxes": boxes,
         "gt_classes": classes,
         "gt_valid": valid,
@@ -105,14 +115,9 @@ def make_batch(cfg):
 
 
 def step_flops(compiled) -> float:
-    """XLA's analytic FLOP count from an already-compiled train step."""
-    try:
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, list):  # older jax: one dict per device
-            analysis = analysis[0]
-        return float(analysis.get("flops", 0.0))
-    except Exception:
-        return 0.0
+    """XLA's analytic FLOP count from an already-compiled train step
+    (graftprof: obs/costs.py owns the full cost/memory extraction)."""
+    return obs_costs.executable_costs(compiled).get("flops", 0.0)
 
 
 def bench_config(cfg, reps: int = 5, iters: int = 20):
@@ -149,19 +154,24 @@ def bench_config(cfg, reps: int = 5, iters: int = 20):
     # AOT-compile ONCE and time the compiled executable directly: this
     # pins the donated/device layouts up front (no second trace on the
     # first donated call) and gives cost_analysis() for free — no second
-    # compile just for FLOPs.
-    rng, k0 = jax.random.split(rng)
-    compiled = step_fn.lower(state, batch, k0).compile()
-    # XLA cost analysis counts a lax.scan BODY once, not per trip
-    # (verified: the msd8 program reports the same flops as one step), so
-    # this is per-OPTIMIZER-STEP flops for every recipe.
-    flops = step_flops(compiled)
+    # compile just for FLOPs. The compile counter (graftprof) tallies
+    # the real XLA compiles this row triggered — incl. the warmups, so
+    # a donation-layout recompile shows up in compile_s too; a warm
+    # persistent-cache run honestly reports 0.
+    with compile_track.count() as cc:
+        rng, k0 = jax.random.split(rng)
+        compiled = step_fn.lower(state, batch, k0).compile()
+        # XLA cost analysis counts a lax.scan BODY once, not per trip
+        # (verified: the msd8 program reports the same flops as one
+        # step), so this is per-OPTIMIZER-STEP flops for every recipe.
+        costs = obs_costs.executable_costs(compiled)
+        flops = costs.get("flops", 0.0)
 
-    # Warmup dispatches through the compiled executable.
-    for _ in range(4):
-        rng, k = jax.random.split(rng)
-        state, metrics = compiled(state, batch, k)
-        float(np.asarray(metrics["TotalLoss"]))
+        # Warmup dispatches through the compiled executable.
+        for _ in range(4):
+            rng, k = jax.random.split(rng)
+            state, metrics = compiled(state, batch, k)
+            float(np.asarray(metrics["TotalLoss"]))
 
     imgs_per_dispatch = b * multi
     rates = []
@@ -180,12 +190,20 @@ def bench_config(cfg, reps: int = 5, iters: int = 20):
 
     # cost_analysis() counts the PER-DEVICE (SPMD-partitioned) program, so
     # per-device flops x steps/sec / per-chip peak is already the
-    # per-chip MFU — no extra device_count factor.
-    mfu = (flops * img_s / b) / V5E_PEAK_FLOPS if flops else None
+    # per-chip MFU — no extra device_count factor (obs_costs.mfu_from).
+    mfu = obs_costs.mfu_from(flops, img_s / b)
+    pad = obs_costs.batch_pad_waste(batch)
     return {
         "img_s_per_chip": round(per_chip, 3),
         "step_ms": round(step_ms, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # graftprof: the executable's HBM footprint (args+temps+output
+        # −alias from memory_analysis) and this batch's padding waste —
+        # the HBM headroom and canvas-packing numbers the ledger tracks.
+        "hbm_bytes": costs.get("hbm_bytes"),
+        "pad_waste": pad.get("pad_waste"),
+        "compile_s": round(cc.seconds, 3),
+        "n_executables": cc.n,
         "reps_img_s": [round(r, 2) for r in rates],
     }
 
@@ -233,14 +251,17 @@ def bench_update_config(cfg, reps: int = 5, iters: int = 50):
     flat_state = core.init_state(params)
     fgrads = {d: jax.numpy.asarray(b)
               for d, b in core.table.flatten(grads).items()}
-    tree_ms = timed(create_train_state(params, tx), grads)
-    flat_ms = timed(flat_state, fgrads)
+    with compile_track.count() as cc:  # graftprof compile accounting
+        tree_ms = timed(create_train_state(params, tx), grads)
+        flat_ms = timed(flat_state, fgrads)
     return {
         "tree_ms": round(tree_ms, 3),
         "flat_ms": round(flat_ms, 3),
         "speedup": round(tree_ms / flat_ms, 3) if flat_ms else None,
         "param_leaves": n_leaves,
         "optimizer": cfg.train.optimizer,
+        "compile_s": round(cc.seconds, 3),
+        "n_executables": cc.n,
     }
 
 
@@ -262,10 +283,12 @@ def bench_eval_config(cfg, batch_size: int = 4, reps: int = 5,
     images = rs.randn(batch_size, h, w, 3).astype(np.float32)
     im_info = np.asarray([[600, 1000, 1.0]] * batch_size, np.float32)
 
-    compiled = predictor._detect.lower(params, images, im_info).compile()
-    flops = step_flops(compiled)
-    for _ in range(3):
-        np.asarray(compiled(params, images, im_info))  # warmup + barrier
+    with compile_track.count() as cc:
+        compiled = predictor._detect.lower(params, images, im_info).compile()
+        costs = obs_costs.executable_costs(compiled)
+        flops = costs.get("flops", 0.0)
+        for _ in range(3):
+            np.asarray(compiled(params, images, im_info))  # warmup + barrier
     rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -277,12 +300,15 @@ def bench_eval_config(cfg, batch_size: int = 4, reps: int = 5,
     # The detect program is a plain jit on ONE device (no mesh), so the
     # measured rate already IS the per-chip rate — no device_count division
     # (unlike bench_config, whose step shards over all devices).
-    mfu = (flops * img_s / batch_size) / V5E_PEAK_FLOPS if flops else None
+    mfu = obs_costs.mfu_from(flops, img_s / batch_size)
     return {
         "img_s_per_chip": round(img_s, 3),
         "batch_size": batch_size,
         "ms_per_img": round(1000.0 / img_s, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "hbm_bytes": costs.get("hbm_bytes"),
+        "compile_s": round(cc.seconds, 3),
+        "n_executables": cc.n,
         "reps_img_s": [round(r, 2) for r in rates],
     }
 
@@ -305,7 +331,7 @@ def flush_partial(path: str, payload: dict):
 
 def run_sweep(configs: dict, runner, detail=None, elog=None,
               flush_path=None, attempts: int = 2,
-              timeout_s: Optional[float] = None):
+              timeout_s: Optional[float] = None, on_row=None):
     """Measure each config, recording errors per-row (a relay drop must
     not lose the sweep) and flushing the accumulated detail dict to
     `flush_path` after EVERY config.
@@ -339,6 +365,11 @@ def run_sweep(configs: dict, runner, detail=None, elog=None,
             elog.emit("bench", config=name, **detail[name])
         if flush_path:
             flush_partial(flush_path, detail)
+        if on_row is not None:
+            # graftprof perf ledger: each completed row is appended the
+            # moment it lands (same crash-durability contract as
+            # flush_partial — a killed sweep keeps its ledger history).
+            on_row(name, detail[name])
     return detail
 
 
@@ -444,8 +475,35 @@ def main():
     # (rc=124-proof; see flush_partial). The final report supersedes it.
     flush_path = os.environ.get("MX_RCNN_BENCH_PARTIAL",
                                 os.path.join(obs_dir, "partial.json"))
+
+    # graftprof perf ledger (obs/ledger.py): every completed row is also
+    # appended to the cross-run history (PERF_LEDGER.jsonl at the repo
+    # root; MX_RCNN_PERF_LEDGER overrides, empty disables). The round
+    # tag comes from MX_RCNN_BENCH_ROUND when the driver exports it.
+    from mx_rcnn_tpu.obs import ledger as perf_ledger
+
+    ledger_path = os.environ.get("MX_RCNN_PERF_LEDGER",
+                                 perf_ledger.default_path())
+    bench_round = os.environ.get("MX_RCNN_BENCH_ROUND")
+    if bench_round:
+        bench_round = int(bench_round)
+    elif ledger_path:
+        # No explicit round: key this sweep as the next one after the
+        # ledger's latest, so `ledger check` (which grades the latest
+        # round against everything before) always sees these rows.
+        prior = perf_ledger.latest_round(perf_ledger.load_rows(ledger_path))
+        bench_round = (prior + 1) if prior is not None else None
+    ledger_sha = perf_ledger._git_sha()
+
+    def ledger_row(name, row):
+        if not ledger_path:
+            return
+        perf_ledger.append_rows(ledger_path, [perf_ledger.normalize_row(
+            name, row, round_=bench_round, sha=ledger_sha, source="bench")])
+
     detail = run_sweep(configs, bench_config, elog=elog,
-                       flush_path=flush_path, timeout_s=timeout_s)
+                       flush_path=flush_path, timeout_s=timeout_s,
+                       on_row=ledger_row)
 
     # Isolated optimizer-update microbench (tree vs flat) at full model
     # size: the ~6 ms many-buffer floor, tracked per round in the JSON
@@ -457,7 +515,8 @@ def main():
             "image.pad_shape": (640, 1024)}),
     }
     run_sweep(update_configs, bench_update_config, detail=detail,
-              elog=elog, flush_path=flush_path, timeout_s=timeout_s)
+              elog=elog, flush_path=flush_path, timeout_s=timeout_s,
+              on_row=ledger_row)
 
     # Inference path (SURVEY §4.2 call stack: test.py → Predictor →
     # pred_eval): the jitted detect program at the test proposal budget.
@@ -468,7 +527,8 @@ def main():
             "image.pad_shape": (640, 1024)}),
     }
     run_sweep(eval_configs, bench_eval_config, detail=detail,
-              elog=elog, flush_path=flush_path, timeout_s=timeout_s)
+              elog=elog, flush_path=flush_path, timeout_s=timeout_s,
+              on_row=ledger_row)
 
     # Headline: best C4 recipe — same model, same shapes, same work per
     # optimizer step across recipes.
@@ -480,6 +540,11 @@ def main():
         headline_mfu = c4[headline_config].get("mfu")
     else:  # every C4 attempt hit a relay error — still emit the line
         headline_config, headline, headline_mfu = "error", 0.0, None
+    if c4:
+        # Ledger continuity: rounds r01/r02 predate per-config detail and
+        # exist only as headline rows — keep appending one per sweep.
+        ledger_row("headline", {"img_s_per_chip": headline,
+                                "mfu": headline_mfu})
 
     compile_track.deactivate()
     elog.close()

@@ -15,10 +15,12 @@ from mx_rcnn_tpu.analysis.rules import (
     prng,
     retry,
     shapes,
+    time_in_jit,
 )
 
 ALL_RULES = (
     host_sync,
+    time_in_jit,
     shapes,
     donation,
     prng,

@@ -1,0 +1,71 @@
+"""time-in-jit: host clock reads inside traced code.
+
+``time.monotonic()`` / ``time.perf_counter()`` / ``time.time()`` (and
+their ``_ns`` variants) evaluate ONCE, at trace time, inside jit — the
+"timestamp" baked into the compiled program is the moment of the trace,
+not of any execution, and every later call reuses it. The bug is silent:
+nothing fails, durations come out as 0 or constant, and a cost/telemetry
+hook wired one call too deep (exactly the graftprof wiring shape —
+StepTimer/CostTracker sit one function away from the jit boundary)
+quietly measures nothing. Clock reads belong on the host side of the
+boundary; this rule makes the placement mechanical.
+
+Both spellings are covered: attribute calls (``time.perf_counter()``)
+and names bound by ``from time import perf_counter``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import dotted_name
+
+NAME = "time-in-jit"
+RATIONALE = ("time.time()/monotonic()/perf_counter() inside traced code "
+             "evaluates at TRACE time and becomes a compiled-in constant "
+             "— timing hooks belong outside the jit boundary")
+
+#: clock reads that concretize host time (time module surface)
+_CLOCKS = frozenset({
+    "time", "monotonic", "perf_counter",
+    "time_ns", "monotonic_ns", "perf_counter_ns",
+})
+_DOTTED = frozenset(f"time.{c}" for c in _CLOCKS)
+
+
+def _from_time_imports(tree: ast.AST) -> frozenset:
+    """Local names bound to time-module clocks via ``from time import``
+    (including aliases: ``from time import perf_counter as clock``)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in _CLOCKS:
+                    names.add(alias.asname or alias.name)
+    return frozenset(names)
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    traced = ctx.traced
+    if not traced.traced:
+        return
+    bare = _from_time_imports(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name in _DOTTED:
+            clock = name
+        elif (isinstance(node.func, ast.Name) and node.func.id in bare):
+            clock = f"time.{node.func.id}"
+        else:
+            continue
+        if not traced.in_traced_code(node):
+            continue
+        yield ctx.finding(
+            NAME, node,
+            f"`{clock}()` inside traced code is evaluated once at trace "
+            "time and compiled in as a constant — move the clock read to "
+            "the host side of the jit boundary")

@@ -344,6 +344,18 @@ class ObsConfig:
     stall_factor: float = 10.0
     stall_min_s: float = 120.0
     watchdog_poll_s: float = 5.0
+    # graftprof (obs/costs.py): per-compiled-shape-bucket XLA cost/memory
+    # accounting — one `cost` event per bucket (flops, HBM split), the
+    # basis of the computed MFU in step/bench reports. Costs one AOT
+    # trace per bucket (the XLA compile itself is a cache hit).
+    cost_analysis: bool = True
+    # graftprof (obs/profile.py): arm a jax.profiler capture window
+    # around global step K (0 = off), N completed steps long, saved
+    # under "<obs dir>/trace/stepK" and folded into a `trace` event.
+    # The stall watchdog additionally auto-arms one window when it
+    # fires, independent of this knob.
+    trace_at_step: int = 0
+    trace_steps: int = 3
 
 
 @dataclass(frozen=True)

@@ -248,9 +248,43 @@ class _CloseableLoader:
     their own pool), so `close()` (or `with loader: ...`) joins all
     worker threads even when an epoch was abandoned mid-stream.
     Exhausting an iterator closes its prefetcher automatically; close()
-    is the explicit hook for early exits (tools/train.py epoch end)."""
+    is the explicit hook for early exits (tools/train.py epoch end).
+
+    Also hosts the graftprof pad-waste counters: batch assembly calls
+    ``_note_pad(real_px, canvas_px)`` (from worker threads — locked), so
+    ``pad_waste_stats()`` reports what fraction of every canvas pixel
+    the run paid for padding — the measured baseline of the ROADMAP's
+    canvas-packing lever. Counters are cumulative over the loader's
+    lifetime (fit_detector folds them into each epoch event)."""
 
     _active: Tuple[_PrefetchIterator, ...] = ()
+    #: shared class-level lock — _note_pad is called from prefetch WORKER
+    #: threads, which start before any per-instance init could run; a
+    #: lazily-created instance lock would race its own creation.
+    #: Contention is a few batches/sec across all loaders — negligible.
+    _pad_lock: threading.Lock = threading.Lock()
+    _pad_real_px = 0
+    _pad_canvas_px = 0
+    _pad_batches = 0
+
+    def _note_pad(self, real_px: float, canvas_px: float):
+        with self._pad_lock:
+            self._pad_real_px += int(real_px)
+            self._pad_canvas_px += int(canvas_px)
+            self._pad_batches += 1
+
+    def pad_waste_stats(self) -> Optional[Dict[str, float]]:
+        """Cumulative padding accounting, or None before the first
+        batch. ``pad_waste`` = 1 − real/canvas pixels."""
+        if not self._pad_canvas_px:
+            return None
+        return {
+            "real_px": self._pad_real_px,
+            "canvas_px": self._pad_canvas_px,
+            "batches": self._pad_batches,
+            "pad_waste": round(
+                1.0 - self._pad_real_px / self._pad_canvas_px, 4),
+        }
 
     def _run_prefetch(self, it: _PrefetchIterator):
         self._active = self._active + (it,)
@@ -380,6 +414,10 @@ class AnchorLoader(_CloseableLoader):
         }
         if with_masks:
             batch["gt_masks"] = np.stack(gtm)
+        # graftprof pad accounting: im_info rows are [h, w, scale] with
+        # (h, w) the pre-pad content size — a few adds per batch.
+        self._note_pad(sum(float(i[0]) * float(i[1]) for i in infos),
+                       len(idxs) * pad[0] * pad[1])
         return batch
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
@@ -485,6 +523,8 @@ class TestLoader(_CloseableLoader):
             imgs.append(img)
             infos.append(info)
             metas.append({"index": i, "scale": float(info[2]), "real": real})
+        self._note_pad(sum(float(i[0]) * float(i[1]) for i in infos),
+                       len(idxs) * pad[0] * pad[1])
         return {"image": np.stack(imgs), "im_info": np.stack(infos)}, metas
 
     def __iter__(self):

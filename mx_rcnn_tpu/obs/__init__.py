@@ -14,6 +14,18 @@ graftscope adds the machine-readable layer underneath it:
 - ``report``:        ``python -m mx_rcnn_tpu.obs.report`` folds a run's
                      JSONL into a human summary + BENCH-compatible JSON
 
+graftprof (this layer's profiling/cost pass) adds:
+
+- ``costs``:         XLA ``cost_analysis``/``memory_analysis`` per
+                     compiled shape bucket → ``cost`` events, computed
+                     MFU, HBM footprint, padding-waste accounting
+- ``profile``:       programmatic jax.profiler capture windows
+                     (``obs.trace_at_step``; stall-armed) + a coarse
+                     trace summarizer → ``trace`` events
+- ``ledger``:        ``python -m mx_rcnn_tpu.obs.ledger`` — append-only
+                     cross-run perf history (PERF_LEDGER.jsonl) with a
+                     regression-gating ``check`` subcommand
+
 Enable on any training entry point with config overrides::
 
     --set obs.enabled=true --set obs.dir=runs/myrun
@@ -35,6 +47,12 @@ from mx_rcnn_tpu.obs.events import (
 )
 from mx_rcnn_tpu.obs.timing import StepTimer
 from mx_rcnn_tpu.obs.watchdog import StallWatchdog
+
+# NOTE: costs (CostTracker) and profile (TraceController) are NOT
+# imported here — costs needs numpy, and the `python -m
+# mx_rcnn_tpu.obs.report` / `...obs.ledger` CLIs promise a stdlib-only
+# import chain (foldable on any machine the JSON is copied to). Import
+# them from their submodules.
 
 __all__ = [
     "EVENT_TYPES",

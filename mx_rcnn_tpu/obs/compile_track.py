@@ -54,22 +54,42 @@ def shape_signature() -> Optional[Dict[str, Any]]:
         return {"batch": [repr(type(batch))]}
 
 
+class CompileCounter:
+    """Tally of real XLA compiles observed while registered — graftprof's
+    per-bench-row compile accounting (``compile_s`` / ``n_executables``).
+    A persistent-cache hit fires no backend_compile event, so a warm row
+    honestly reports 0 executables built."""
+
+    def __init__(self):
+        self.n = 0
+        self.seconds = 0.0
+
+
+_counters: list = []
+
+
 def _on_event_duration(event: str, duration_secs: float, **kwargs) -> None:
-    log = _active
-    if log is None or _COMPILE_MARKER not in event:
+    if _COMPILE_MARKER not in event:
         return
     phase = event.rsplit("/", 1)[-1]
     if phase.endswith(_COMPILE_SUFFIX):
         phase = phase[: -len(_COMPILE_SUFFIX)]
+    if phase == "backend_compile" and _counters:
+        with _lock:
+            for c in _counters:
+                c.n += 1
+                c.seconds += duration_secs
+    log = _active
+    if log is None:
+        return
     log.emit("compile", phase=phase, event=event,
              duration_ms=round(duration_secs * 1e3, 3),
              shapes=shape_signature())
 
 
-def activate(log: EventLog) -> bool:
-    """Route compile events to ``log``. Returns False when jax (or its
-    monitoring bus) is unavailable — telemetry degrades, never blocks."""
-    global _active, _installed
+def _ensure_installed() -> bool:
+    """Register the jax.monitoring listener once per process."""
+    global _installed
     with _lock:
         if not _installed:
             try:
@@ -80,6 +100,44 @@ def activate(log: EventLog) -> bool:
             except (ImportError, AttributeError):
                 return False
             _installed = True
+    return True
+
+
+def count() -> "_CountContext":
+    """Context manager tallying backend compiles in its window::
+
+        with compile_track.count() as cc:
+            ...  # compiles here
+        row["compile_s"], row["n_executables"] = cc.seconds, cc.n
+
+    Independent of any active EventLog (bench child processes count
+    their own compiles with no sink attached); nested counters all see
+    every compile in their window."""
+    return _CountContext()
+
+
+class _CountContext:
+    def __enter__(self) -> CompileCounter:
+        self.counter = CompileCounter()
+        if _ensure_installed():
+            with _lock:
+                _counters.append(self.counter)
+        return self.counter
+
+    def __exit__(self, *exc):
+        with _lock:
+            if self.counter in _counters:
+                _counters.remove(self.counter)
+        return False
+
+
+def activate(log: EventLog) -> bool:
+    """Route compile events to ``log``. Returns False when jax (or its
+    monitoring bus) is unavailable — telemetry degrades, never blocks."""
+    global _active
+    if not _ensure_installed():
+        return False
+    with _lock:
         _active = log
     return True
 

@@ -58,6 +58,10 @@ EVENT_TYPES = (
     "heal",        # graftheal: step-time backend loss recovered in-process
                    # (capture mode, downtime_s, devices before/after —
                    # resilience/heal.py)
+    "cost",        # graftprof: XLA cost/memory accounting for one
+                   # compiled shape bucket (flops, hbm split — obs/costs.py)
+    "trace",       # graftprof: one closed jax.profiler capture window
+                   # (dir + coarse phase summary — obs/profile.py)
 )
 
 #: Buffered kinds — everything else flushes to disk immediately, so the

@@ -1,0 +1,198 @@
+"""graftprof trace windows — programmatic jax.profiler capture + folding.
+
+``tools/profile.py`` can capture a trace of a synthetic step, but the
+numbers that matter come from REAL runs — and nobody restarts a 12-hour
+train job under TensorBoard. This module arms a capture window inside
+the run itself:
+
+- ``--set obs.trace_at_step=K`` (with ``obs.trace_steps=N``, default 3)
+  starts a ``jax.profiler`` trace just before global step K and stops it
+  N completed steps later, saving under ``<obs dir>/trace``;
+- the stall watchdog auto-arms ONE window when it fires (before the
+  stack dump), so a mysteriously slow/hung run leaves a trace of what
+  the host was doing during the stall — closed at the next completed
+  step or at teardown;
+- every closed window emits a ``trace`` event carrying the capture dir
+  and a coarse folded summary, so ``obs.report`` shows the breakdown
+  without TensorBoard.
+
+``summarize_trace`` folds the profiler's Chrome-trace JSON
+(``*.trace.json.gz`` — written alongside the xplane protobuf, stdlib-
+parseable) into a phase breakdown: ``forward`` / ``backward`` /
+``update`` / ``host`` / ``infra``. The split is a NAME HEURISTIC over
+trace events (XLA op/fusion names and host-side TraceMe labels) — good
+for "where does the time go" at the granularity the MFU levers need,
+not a replacement for the full TensorBoard view (the trace dir keeps
+the xplane for that).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional
+
+#: phase classification, first match wins (order matters: an op named
+#: "transpose.fusion.adam" is an update op). Host/infra events are
+#: runtime machinery and python frames; the remainder — actual compute
+#: ops without a backward/update marker — folds into forward.
+_PHASE_PATTERNS = (
+    ("update", re.compile(
+        r"(adamw?|sgd|apply_grad|optimizer|flat_(sgd|adamw)|momentum)",
+        re.IGNORECASE)),
+    ("backward", re.compile(
+        r"(backward|bwd|grad|vjp|transpose)", re.IGNORECASE)),
+    ("host", re.compile(
+        r"^\$|python|PyCall|callback|PjitFunction|ParseArguments|"
+        r"CopyToDevice|TransferTo|BufferFromHost", re.IGNORECASE)),
+    ("infra", re.compile(
+        r"Tfrt|Thunk|Threadpool|Stream|Listener|profiler|XlaModule|"
+        r"Await|Execute", re.IGNORECASE)),
+)
+
+
+def _classify(name: str) -> str:
+    for phase, pat in _PHASE_PATTERNS:
+        if pat.search(name):
+            return phase
+    return "forward"
+
+
+def summarize_trace(trace_dir: str,
+                    top_n: int = 8) -> Optional[Dict[str, Any]]:
+    """Fold the NEWEST ``*.trace.json.gz`` under ``trace_dir`` into
+    ``{phases: {phase: ms}, total_ms, events, top_ops, file}``.
+    Returns None when no trace JSON exists (capture failed or a jax
+    build that writes only xplane)."""
+    hits = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                     recursive=True)
+    if not hits:
+        return None
+    path = max(hits, key=os.path.getmtime)
+    try:
+        with gzip.open(path, "rt", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    phases: Dict[str, float] = {}
+    per_op: Dict[str, float] = {}
+    n = 0
+    for ev in data.get("traceEvents", ()):
+        if ev.get("ph") != "X":
+            continue
+        dur_ms = float(ev.get("dur", 0.0)) / 1e3  # trace units are µs
+        name = str(ev.get("name", "?"))
+        phases[_classify(name)] = phases.get(_classify(name), 0.0) + dur_ms
+        per_op[name] = per_op.get(name, 0.0) + dur_ms
+        n += 1
+    top = sorted(per_op.items(), key=lambda kv: -kv[1])[:top_n]
+    return {
+        "file": os.path.relpath(path, trace_dir),
+        "events": n,
+        "total_ms": round(sum(phases.values()), 3),
+        "phases": {k: round(v, 3) for k, v in sorted(phases.items())},
+        "top_ops": [{"name": k, "ms": round(v, 3)} for k, v in top],
+    }
+
+
+class TraceController:
+    """Arms/collects jax.profiler windows inside a run.
+
+    Hot-path surface is ``step_completed(total_steps)``: one int compare
+    when nothing is armed. ``stall_window()`` is the watchdog's hook
+    (called from its thread — jax's profiler state is process-global, so
+    cross-thread start/stop is fine); at most one stall window per run.
+    ``close()`` force-stops an open window so the artifact survives the
+    crash/teardown path."""
+
+    def __init__(self, elog, out_dir: str, trace_at_step: int = 0,
+                 trace_steps: int = 3):
+        self.elog = elog
+        self.out_dir = out_dir
+        self.trace_steps = max(1, int(trace_steps))
+        self._arm_at = int(trace_at_step)  # 0 = nothing armed
+        self._lock = threading.Lock()
+        self._active_dir: Optional[str] = None
+        self._active_reason: Optional[str] = None
+        self._stop_after: Optional[int] = None
+        self._stall_used = False
+
+    # -- capture plumbing ---------------------------------------------------
+
+    def _start(self, sub: str, reason: str) -> bool:
+        target = os.path.join(self.out_dir, sub)
+        try:
+            import jax.profiler
+
+            os.makedirs(target, exist_ok=True)
+            jax.profiler.start_trace(target)
+        except Exception as exc:  # noqa: BLE001  # graftlint: disable=broad-except — a profiler that cannot start (already active elsewhere, unsupported build) must not take the run down
+            from mx_rcnn_tpu.logger import logger
+
+            logger.warning("graftprof: trace start failed: %r", exc)
+            return False
+        self._active_dir = target
+        self._active_reason = reason
+        return True
+
+    def _stop_and_emit(self):
+        target, reason = self._active_dir, self._active_reason
+        self._active_dir = self._active_reason = None
+        self._stop_after = None
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001  # graftlint: disable=broad-except — same survival contract as _start
+            from mx_rcnn_tpu.logger import logger
+
+            logger.warning("graftprof: trace stop failed: %r", exc)
+            return
+        if self.elog.enabled:
+            self.elog.emit("trace", dir=target, reason=reason,
+                           summary=summarize_trace(target))
+
+    # -- public surface -----------------------------------------------------
+
+    def before_step(self, step: int):
+        """Called just before dispatching global step ``step``: opens the
+        armed window so the capture INCLUDES step ``trace_at_step`` —
+        step 1 (the compile-heavy first dispatch) is capturable too."""
+        with self._lock:
+            if self._active_dir is not None:
+                return
+            if self._arm_at and step >= self._arm_at:
+                at = self._arm_at
+                self._arm_at = 0  # one window per arming
+                if self._start(f"step{at}", reason=f"step {at}"):
+                    # window spans steps at..at+N-1 (N = trace_steps)
+                    self._stop_after = step + self.trace_steps - 1
+
+    def step_completed(self, step: int):
+        """Called once per completed dispatch: closes the open window
+        when its step budget is spent (a stall window, which has no
+        budget, closes on the first completed step after it)."""
+        with self._lock:
+            if self._active_dir is not None and (
+                    self._stop_after is None or step >= self._stop_after):
+                self._stop_and_emit()
+
+    def stall_window(self):
+        """Watchdog hook: open ONE trace window for the stall in flight.
+        Closed at the next completed step (if the run recovers) or at
+        close() (if it dies) — either way the capture lands on disk."""
+        with self._lock:
+            if self._stall_used or self._active_dir is not None:
+                return
+            self._stall_used = True
+            self._start("stall", reason="stall")
+            # no step budget: the next heartbeat (or teardown) closes it
+
+    def close(self):
+        with self._lock:
+            if self._active_dir is not None:
+                self._stop_and_emit()

@@ -46,6 +46,49 @@ def _percentile(sorted_vals: List[float], pct: float) -> float:
     return sorted_vals[idx]
 
 
+def _fold_costs(cost_events, timed, all_step_ms: List[float],
+                multi: int) -> Dict[str, Any]:
+    """Join per-bucket XLA cost accounting (graftprof `cost` events) with
+    the measured step times of that bucket's canvas → per-bucket and
+    aggregate MFU. ``multi`` (train.multi_step_dispatch) converts a
+    dispatch's wall time into per-optimizer-step time — cost_analysis
+    counts a scan body once, so flops are already per step."""
+    buckets = []
+    agg_flops = agg_time_s = 0.0
+    for c in cost_events:
+        shapes = c.get("shapes") or {}
+        img = shapes.get("image") or ()
+        canvas = list(img[-3:-1]) if len(img) >= 3 else None
+        in_bucket = sorted(
+            e["step_ms"] for e in timed
+            if canvas is None or e.get("canvas") == canvas) or all_step_ms
+        p50 = _percentile(in_bucket, 50)
+        flops = c.get("flops")
+        peak = c.get("peak_flops") or 0.0
+        step_s = (p50 / 1e3) / max(1, multi)
+        mfu = (flops / step_s / peak
+               if flops and step_s > 0 and peak > 0 else None)
+        if flops and in_bucket and p50 > 0:
+            agg_flops += flops * len(in_bucket) * max(1, multi)
+            agg_time_s += (p50 / 1e3) * len(in_bucket)
+        buckets.append({
+            "canvas": canvas,
+            "flops": flops,
+            "bytes_accessed": c.get("bytes_accessed"),
+            "hbm_bytes": c.get("hbm_bytes"),
+            "steps": len(in_bucket),
+            "step_ms_p50": round(p50, 3),
+            "mfu": round(mfu, 4) if mfu is not None else None,
+        })
+    peak = next((c.get("peak_flops") for c in cost_events
+                 if c.get("peak_flops")), None)
+    overall = (round(agg_flops / agg_time_s / peak, 4)
+               if peak and agg_time_s > 0 and agg_flops > 0 else None)
+    hbm = [b["hbm_bytes"] for b in buckets if b.get("hbm_bytes")]
+    return {"buckets": buckets, "mfu": overall,
+            "hbm_bytes": max(hbm) if hbm else None}
+
+
 def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold an event list into the run summary dict (the --json payload's
     ``detail``). Keys are stable — BENCH tooling reads them."""
@@ -81,6 +124,14 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     # recompile — the silent throughput killer the tracker exists for.
     recompiles = [e for e in compiles if e.get("step", 0) >= 1]
 
+    # graftprof: per-bucket cost accounting joined with measured step
+    # time → computed MFU (obs/costs.py emits one `cost` event per
+    # compiled shape bucket; step events carry the batch canvas).
+    multi = run_meta.get("multi_step_dispatch") or 1
+    cost = _fold_costs(by_type.get("cost", ()), timed, step_ms, multi)
+    pad_vals = sorted(e["pad_waste"] for e in timed if "pad_waste" in e)
+    pad_waste = (round(_percentile(pad_vals, 50), 4) if pad_vals else None)
+
     crash = (by_type.get("crash") or [None])[-1]
     summary: Dict[str, Any] = {
         "run": {k: run_meta.get(k) for k in
@@ -109,6 +160,11 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "steady_state_count": len(recompiles),
             "steady_state_shapes": [e.get("shapes") for e in recompiles],
         },
+        "cost": cost,
+        "pad_waste": pad_waste,
+        "traces": [{"dir": e.get("dir"), "reason": e.get("reason"),
+                    "summary": e.get("summary")}
+                   for e in by_type.get("trace", ())],
         "checkpoints": len(by_type.get("checkpoint", ())),
         "evals": [e.get("results") for e in by_type.get("eval", ())],
         "bench": {e.get("config", f"cfg{i}"):
@@ -166,6 +222,11 @@ def bench_blob(summary: Dict[str, Any]) -> Dict[str, Any]:
         "stall_count": summary["stalls"],
         "backend_retries": summary["backend"]["retries"],
         "heal_count": summary["heals"]["count"],
+        # graftprof: the computed-MFU / HBM / padding numbers regression
+        # gates (obs/ledger.py) track alongside throughput.
+        "mfu": summary["cost"]["mfu"],
+        "hbm_bytes": summary["cost"]["hbm_bytes"],
+        "pad_waste": summary["pad_waste"],
         "detail": summary,
     }
 
@@ -191,6 +252,25 @@ def render(summary: Dict[str, Any]) -> str:
         f"{co['steady_state_count']} in steady state",
         f"  stalls:     {summary['stalls']}",
     ]
+    cost = summary.get("cost") or {}
+    if cost.get("buckets"):
+        hbm = cost.get("hbm_bytes")
+        lines.append(
+            f"  cost:       mfu {cost.get('mfu')} | hbm "
+            f"{hbm / 1e9:.2f} GB | {len(cost['buckets'])} bucket(s): "
+            + ", ".join(
+                f"{b.get('canvas')} mfu={b.get('mfu')}"
+                for b in cost["buckets"])
+            if hbm else
+            f"  cost:       mfu {cost.get('mfu')} | "
+            f"{len(cost['buckets'])} bucket(s)")
+    if summary.get("pad_waste") is not None:
+        lines.append(f"  pad waste:  {summary['pad_waste']:.1%} of canvas "
+                     "pixels (p50)")
+    for t in summary.get("traces", ()):
+        ph = (t.get("summary") or {}).get("phases")
+        lines.append(f"  trace:      [{t.get('reason')}] {t.get('dir')}"
+                     + (f" phases(ms)={ph}" if ph else ""))
     be = summary.get("backend", {})
     if be.get("retries"):
         lines.append(

@@ -48,10 +48,16 @@ class StepTimer:
     event can name the batch shapes that triggered it.
     """
 
-    def __init__(self, log: EventLog, watchdog=None, track_shapes=True):
+    def __init__(self, log: EventLog, watchdog=None, track_shapes=True,
+                 enrich=None):
+        """``enrich``: optional ``batch -> dict`` of extra fields for each
+        step event (graftprof attaches the canvas + pad-waste fraction —
+        host-side numpy over im_info, no device touch). Only called when
+        the sink is enabled; must never raise for a well-formed batch."""
         self.log = log
         self.watchdog = watchdog
         self.track_shapes = track_shapes
+        self.enrich = enrich
         self.total_steps = 0
         self._t_dispatch = None
 
@@ -97,6 +103,8 @@ class StepTimer:
             if self._t_dispatch is not None:
                 fields["dispatch_ms"] = round(
                     (self._t_dispatch - t1) * 1e3, 3)
+            if self.enrich is not None:
+                fields.update(self.enrich(batch) or {})
             self.log.emit("step", **fields)
             if self.watchdog is not None:
                 self.watchdog.beat(step_s)

@@ -57,8 +57,13 @@ class StallWatchdog:
 
     def __init__(self, log: EventLog, stall_factor: float = 10.0,
                  min_stall_s: float = 60.0, poll_s: float = 5.0,
-                 window: int = 101):
+                 window: int = 101, tracer=None):
+        """``tracer``: optional graftprof TraceController — when a stall
+        fires, ONE jax.profiler window is auto-armed before the stack
+        dump (``tracer.stall_window()``), so a hung run leaves a trace
+        of the stall alongside the stacks (obs/profile.py)."""
         self.log = log
+        self.tracer = tracer
         self.stall_factor = float(stall_factor)
         self.min_stall_s = float(min_stall_s)
         self.poll_s = float(poll_s)
@@ -134,6 +139,11 @@ class StallWatchdog:
         with self._lock:
             self._fired = True
             self._stalls += 1
+        if self.tracer is not None:
+            # Arm the stall trace BEFORE dumping: the capture brackets
+            # whatever the stalled threads do next (profile.py bounds it
+            # to one window per run and closes it at teardown).
+            self.tracer.stall_window()
         self.log.emit(
             "stall",
             waited_s=round(waited, 3),

@@ -186,6 +186,18 @@ def main(argv=None):
                 state, metrics = run_step(state, batch, k)
             jax.block_until_ready(metrics["TotalLoss"])
         print(f"trace written to {args.trace_dir}")
+        # graftprof: fold the capture into the coarse phase breakdown
+        # (obs/profile.py) so the split is readable without TensorBoard.
+        from mx_rcnn_tpu.obs.profile import summarize_trace
+
+        summary = summarize_trace(args.trace_dir)
+        if summary:
+            print("trace phases (ms): "
+                  + ", ".join(f"{k}={v}"
+                              for k, v in summary["phases"].items()))
+            if elog is not None and elog.enabled:
+                elog.emit("trace", dir=args.trace_dir, reason="manual",
+                          summary=summary)
 
     if elog is not None:
         from mx_rcnn_tpu.obs import compile_track

@@ -9,6 +9,7 @@ funnel through `fit_detector`.
 from __future__ import annotations
 
 import itertools
+import os
 from typing import Callable, Dict, List, Optional, Union
 
 import jax
@@ -20,8 +21,16 @@ from mx_rcnn_tpu.data.datasets.imdb import filter_roidb, merge_roidb
 from mx_rcnn_tpu.data.loader import AnchorLoader
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models.zoo import build_model, forward_train, init_params
-from mx_rcnn_tpu.obs import StallWatchdog, StepTimer, obs_from_config, run_meta_fields
+from mx_rcnn_tpu.obs import (
+    StallWatchdog,
+    StepTimer,
+    obs_from_config,
+    run_meta_fields,
+)
 from mx_rcnn_tpu.obs import compile_track
+from mx_rcnn_tpu.obs import costs as obs_costs
+from mx_rcnn_tpu.obs.costs import CostTracker
+from mx_rcnn_tpu.obs.profile import TraceController
 from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
 from mx_rcnn_tpu.resilience import (
     HealCarry,
@@ -310,7 +319,7 @@ def fit_detector(
     # graftscope telemetry (mx_rcnn_tpu/obs): the sink was opened at the
     # top of this function (backend acquisition emits through it); a
     # no-op unless cfg.obs.enabled — nothing added to the hot loop.
-    watchdog = None
+    watchdog = tracer = cost_tracker = None
     if obs_log.enabled:
         obs_log.emit("run_meta", **run_meta_fields(
             cfg, mesh=mesh, prefix=prefix, batch_size=ipd,
@@ -319,13 +328,24 @@ def fit_detector(
             multi_step_dispatch=multi))
         if cfg.obs.track_compiles:
             compile_track.activate(obs_log)
+        # graftprof: trace windows (obs.trace_at_step counts dispatches
+        # completed THIS process — also stall-armed by the watchdog) and
+        # per-shape-bucket XLA cost events for the computed MFU.
+        tracer = TraceController(
+            obs_log, os.path.join(os.path.dirname(obs_log.path), "trace"),
+            trace_at_step=cfg.obs.trace_at_step,
+            trace_steps=cfg.obs.trace_steps)
+        if cfg.obs.cost_analysis:
+            cost_tracker = CostTracker(obs_log)
         if cfg.obs.watchdog:
             watchdog = StallWatchdog(
                 obs_log, stall_factor=cfg.obs.stall_factor,
                 min_stall_s=cfg.obs.stall_min_s,
-                poll_s=cfg.obs.watchdog_poll_s)
+                poll_s=cfg.obs.watchdog_poll_s, tracer=tracer)
             watchdog.start()
-    timer = StepTimer(obs_log, watchdog=watchdog)
+    timer = StepTimer(obs_log, watchdog=watchdog,
+                      enrich=obs_costs.step_fields if obs_log.enabled
+                      else None)
     speedometer = Speedometer(ipd, frequent, event_log=obs_log)
 
     # Async epoch-end saves (train/checkpoint.py CheckpointWriter); the
@@ -461,6 +481,11 @@ def fit_detector(
             try:
                 state = flat_core = bag = None
                 pos = (carry.epoch, carry.dispatch)
+                if cost_tracker is not None:
+                    # New session, possibly a new per-device program
+                    # (elastic re-mesh keeps the GLOBAL batch shape, so
+                    # the bucket key alone would dedup a now-stale cost)
+                    cost_tracker.reset()
                 if healer is not None:
                     if healer.devices is not None:
                         # Re-acquired backend, possibly smaller: re-cut
@@ -623,14 +648,28 @@ def fit_detector(
                                       + (i + 1) * multi))
                         k = jax.random.fold_in(  # graftlint: disable=prng-key-reuse — the root is folded with a DISTINCT global dispatch index each iteration (the resumable-key derivation; see the rng comment above)
                             rng, epoch * disp_per_epoch + i)
-                        state, metrics = step_fn(
-                            state,
-                            shard_batch(batch, mesh, stacked=multi > 1),
-                            k)
+                        sharded = shard_batch(batch, mesh,
+                                              stacked=multi > 1)
+                        if cost_tracker is not None:
+                            # One AOT cost capture per compiled shape
+                            # bucket (dict lookup otherwise) — the
+                            # `cost` event behind per-bucket MFU.
+                            cost_tracker.observe(step_fn, state, sharded,
+                                                 k)
+                        if tracer is not None:
+                            # Pre-dispatch arming: the window must
+                            # INCLUDE step trace_at_step (even step 1).
+                            tracer.before_step(timer.total_steps + 1)
+                        state, metrics = step_fn(state, sharded, k)
                         pos = (epoch, i + 1)
                         timer.dispatched()
                         bag.update(metrics)
                         speedometer(epoch, i, bag)
+                        if tracer is not None:
+                            # timer.total_steps increments when the
+                            # generator resumes — this dispatch is the
+                            # (+1)th completed.
+                            tracer.step_completed(timer.total_steps + 1)
                         done = i + 1  # dispatches complete in this epoch
                         if healer is not None:
                             healer.note_progress()
@@ -654,9 +693,18 @@ def fit_detector(
                     if obs_log.enabled:
                         # bag.format() above already drained the pending
                         # device scalars — this get() re-reads host-side
-                        # sums only.
+                        # sums only. Pad-waste accounting rides along:
+                        # cumulative real/canvas pixels from the loader
+                        # (graftprof; the canvas-packing baseline).
+                        pad = (loader.pad_waste_stats()
+                               if hasattr(loader, "pad_waste_stats")
+                               else None)
                         obs_log.emit("epoch", epoch=epoch,
-                                     metrics=bag.get())
+                                     metrics=bag.get(),
+                                     **({"pad_waste": pad["pad_waste"],
+                                         "pad_real_px": pad["real_px"],
+                                         "pad_canvas_px": pad["canvas_px"]}
+                                        if pad else {}))
                     # checkpoint_period > 1 (long small-epoch runs, e.g.
                     # the DETR gate's 150 epochs): save every Nth epoch
                     # and always the last — resume granularity traded
@@ -725,6 +773,8 @@ def fit_detector(
             guard.uninstall()
         if watchdog is not None:
             watchdog.stop()
+        if tracer is not None:
+            tracer.close()  # an open stall window must land on disk
         if obs_log.enabled and cfg.obs.track_compiles:
             compile_track.deactivate()
         obs_log.close()

@@ -76,6 +76,10 @@ def tiny_config(flat: bool = False, obs_dir: str = ""):
     if obs_dir:
         over["obs.enabled"] = True
         over["obs.dir"] = obs_dir
+        # graftprof's per-bucket AOT cost capture re-traces the step once
+        # per shape bucket — pure compile-time, but these gates are about
+        # resilience, not attribution; keep them inside the tier-1 budget.
+        over["obs.cost_analysis"] = False
     cfg = generate_config("resnet50", "synthetic", **over)
     return cfg.with_updates(
         network=replace(cfg.network, compute_dtype="float32"),

@@ -909,3 +909,78 @@ def test_chaos_site_near_miss_registered_and_foreign_receivers():
             laser.site("anywhere")   # ditto
     """)
     assert "chaos-site-name" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# time-in-jit
+# ---------------------------------------------------------------------------
+
+def test_time_in_jit_flags_clock_in_jitted_function():
+    findings = lint("""
+        import time
+
+        import jax
+
+        @jax.jit
+        def step(state, batch):
+            t0 = time.perf_counter()   # trace-time constant!
+            return state, t0
+    """)
+    assert "time-in-jit" in rules_of(findings)
+    msg = next(f for f in findings if f.rule == "time-in-jit").message
+    assert "trace" in msg
+
+
+def test_time_in_jit_flags_from_import_in_traced_closure():
+    """from-time imports (aliased too) and same-module reachability:
+    the helper is traced because the jitted root calls it."""
+    findings = lint("""
+        from time import monotonic as clock
+
+        import jax
+
+        def _timed_part(x):
+            return x * clock()
+
+        def step(x):
+            return _timed_part(x) + 1
+
+        run = jax.jit(step)
+    """)
+    assert "time-in-jit" in rules_of(findings)
+
+
+def test_time_in_jit_near_miss_host_side_timing():
+    """Host-side clock reads — the StepTimer/bench shape, including in a
+    module that jits OTHER functions — stay legal."""
+    findings = lint("""
+        import time
+
+        import jax
+
+        def bench(fn, x):
+            compiled = jax.jit(lambda v: v * 2)
+            t0 = time.perf_counter()
+            compiled(x)
+            return time.perf_counter() - t0
+
+        def wall():
+            return time.time()
+    """)
+    assert "time-in-jit" not in rules_of(findings)
+
+
+def test_time_in_jit_near_miss_unrelated_names():
+    """A non-time `time` attribute or a local function named like a
+    clock must not flag."""
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def step(sim, x):
+            return sim.time() + x.sum()
+
+        def perf_counter():
+            return 7
+    """)
+    assert "time-in-jit" not in rules_of(findings)

@@ -40,6 +40,56 @@ def test_bench_update_config_produces_numbers():
     assert out["optimizer"] == "sgd"
 
 
+import pytest
+
+
+@pytest.mark.compile_heavy
+def test_bench_config_rows_carry_cost_fields():
+    """The graftprof acceptance gate (CPU backend path): every bench row
+    carries `mfu`, `hbm_bytes` and `pad_waste` computed from the
+    compiled executable's cost_analysis()/memory_analysis(), plus the
+    compile-zoo accounting (`compile_s`/`n_executables`)."""
+    from dataclasses import replace
+
+    from mx_rcnn_tpu.config import generate_config
+
+    cfg = generate_config("resnet50", "synthetic", **{
+        "train.rpn_pre_nms_top_n": 128, "train.rpn_post_nms_top_n": 32,
+        "train.batch_rois": 16, "train.max_gt_boxes": 4,
+        "train.batch_images": 8,  # the CPU mesh shards over 8 devices
+        "network.anchor_scales": (2, 4),
+        "image.pad_shape": (64, 64)})
+    cfg = cfg.with_updates(
+        network=replace(cfg.network, compute_dtype="float32"))
+    row = bench.bench_config(cfg, reps=1, iters=2)
+    assert row["img_s_per_chip"] > 0
+    assert row["mfu"] is not None and row["mfu"] >= 0
+    assert row["hbm_bytes"] > 0
+    # make_batch's content size is canvas-proportional (600/640 x
+    # 1000/1024), so the padding fraction is a fixed known quantity
+    assert row["pad_waste"] == pytest.approx(
+        1 - (64 * 600 // 640) * (64 * 1000 // 1024) / (64 * 64), abs=1e-3)
+    assert row["compile_s"] >= 0 and row["n_executables"] >= 0
+
+
+def test_run_sweep_on_row_sees_every_completed_row(tmp_path):
+    """The ledger hook: on_row fires per completed config — including
+    error rows — in sweep order (bench.main appends each to the perf
+    ledger the moment it lands, the partial.json durability contract)."""
+    seen = []
+
+    def runner(cfg):
+        if cfg == "boom":
+            raise RuntimeError("relay dropped")
+        return {"img_s_per_chip": 3.0}
+
+    bench.run_sweep({"a": "a", "b": "boom"}, runner, attempts=1,
+                    on_row=lambda name, row: seen.append((name, row)))
+    assert [s[0] for s in seen] == ["a", "b"]
+    assert seen[0][1]["img_s_per_chip"] == 3.0
+    assert "error" in seen[1][1]
+
+
 def test_run_sweep_flushes_after_every_config(tmp_path):
     flush = str(tmp_path / "partial.json")
     seen = []

@@ -255,6 +255,146 @@ def test_watchdog_thread_emits(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# graftprof: cost accounting (obs/costs.py)
+# ---------------------------------------------------------------------------
+
+def test_executable_costs_vs_hand_count(tmp_path):
+    """FLOP/HBM extraction on a tiny jitted matmul vs hand-counted
+    values: a 64x64 @ 64x64 product is 2·64³ FLOPs (+ the sum's
+    epsilon), one 16 KiB input, one f32 scalar output."""
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.obs import costs
+
+    compiled = jax.jit(lambda x: (x @ x).sum()).lower(
+        jnp.ones((64, 64), jnp.float32)).compile()
+    c = costs.executable_costs(compiled)
+    hand = 2 * 64 ** 3
+    assert abs(c["flops"] - hand) / hand < 0.05
+    assert c["hbm_args"] == 64 * 64 * 4
+    assert c["hbm_output"] == 4
+    assert c["hbm_bytes"] >= c["hbm_args"] + c["hbm_output"]
+    assert c["bytes_accessed"] > 0
+
+    # mfu_from: analytic flops x measured rate / peak (and the guards)
+    assert costs.mfu_from(hand, 100.0, peak_flops=float(hand) * 1000
+                          ) == pytest.approx(0.1)
+    assert costs.mfu_from(None, 100.0) is None
+    assert costs.mfu_from(hand, 0.0) is None
+
+
+def test_batch_pad_waste_fraction():
+    """real pixels ÷ canvas pixels from im_info — plain and
+    multi-step-stacked batches; malformed batches degrade to {}."""
+    from mx_rcnn_tpu.obs import costs
+
+    batch = {"image": np.zeros((2, 64, 64, 3), np.float32),
+             "im_info": np.asarray([[32, 64, 1.0], [64, 64, 1.0]],
+                                   np.float32)}
+    pw = costs.batch_pad_waste(batch)
+    assert pw["canvas"] == [64, 64]
+    assert pw["pad_waste"] == pytest.approx(
+        1 - (32 * 64 + 64 * 64) / (2 * 64 * 64))
+    # stacked (K, B, ...) leaves flatten
+    stacked = {"image": np.zeros((2, 2, 64, 64, 3), np.float32),
+               "im_info": np.tile(batch["im_info"], (2, 1, 1))}
+    assert costs.batch_pad_waste(stacked)["pad_waste"] == pw["pad_waste"]
+    assert costs.step_fields(batch) == {"canvas": [64, 64],
+                                        "pad_waste": pw["pad_waste"]}
+    assert costs.batch_pad_waste({"no": "contract"}) == {}
+    assert costs.step_fields({"no": "contract"}) == {}
+
+
+def test_loader_pad_waste_counters():
+    """AnchorLoader accumulates real/canvas pixel counters per batch
+    (from worker threads — the graftprof canvas-packing baseline):
+    128px synthetic images on a 256-pad canvas waste exactly 75%."""
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.data.datasets.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.data.loader import AnchorLoader
+
+    cfg = generate_config("resnet50", "synthetic", **{
+        "image.pad_shape": (256, 256), "image.scales": ((128, 256),),
+        "train.batch_images": 2, "train.flip": False,
+        "train.max_gt_boxes": 4})
+    ds = SyntheticDataset("train", num_images=4, image_size=128,
+                          max_objects=2, min_size_frac=4, max_size_frac=2)
+    loader = AnchorLoader(ds.gt_roidb(), cfg, num_shards=1)
+    assert loader.pad_waste_stats() is None  # nothing assembled yet
+    with loader:
+        n = sum(1 for _ in loader)
+    stats = loader.pad_waste_stats()
+    assert n == 2 and stats["batches"] == 2
+    assert stats["real_px"] == 4 * 128 * 128
+    assert stats["canvas_px"] == 4 * 256 * 256
+    assert stats["pad_waste"] == pytest.approx(0.75)
+
+
+# ---------------------------------------------------------------------------
+# graftprof: trace windows (obs/profile.py)
+# ---------------------------------------------------------------------------
+
+def test_trace_controller_step_window(tmp_path):
+    """obs.trace_at_step semantics: the window opens before step K,
+    closes trace_steps completed steps later, and the closed window
+    emits a `trace` event with the coarse phase summary."""
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.obs.profile import TraceController, summarize_trace
+
+    log = open_event_log(str(tmp_path))
+    tc = TraceController(log, str(tmp_path / "trace"),
+                         trace_at_step=2, trace_steps=1)
+    f = jax.jit(lambda x: x @ x)
+    x = jnp.ones((64, 64))
+    for step in range(1, 5):
+        tc.before_step(step)  # window opens BEFORE step K, so K=1 works
+        f(x).block_until_ready()
+        tc.step_completed(step)
+    tc.close()
+    log.close()
+    traces = [e for e in report.load_events(str(tmp_path))
+              if e["type"] == "trace"]
+    assert len(traces) == 1  # one window per arming
+    assert traces[0]["reason"] == "step 2"
+    summary = traces[0]["summary"]
+    assert summary is not None and summary["events"] > 0
+    assert summary["total_ms"] >= 0
+    assert set(summary["phases"]) <= {"forward", "backward", "update",
+                                      "host", "infra"}
+    # the summarizer is reusable on the saved dir, and honest about
+    # a dir with no capture
+    assert summarize_trace(traces[0]["dir"]) is not None
+    assert summarize_trace(str(tmp_path / "nowhere")) is None
+
+
+def test_watchdog_stall_arms_trace_window(tmp_path):
+    """The stall tripwire opens ONE trace window before dumping stacks;
+    the next completed step closes it into a `trace` event."""
+    from mx_rcnn_tpu.obs.profile import TraceController
+
+    log = open_event_log(str(tmp_path))
+    tc = TraceController(log, str(tmp_path / "trace"))
+    wd = StallWatchdog(log, stall_factor=2.0, min_stall_s=0.01,
+                       poll_s=10, tracer=tc)
+    wd.beat(0.005)
+    assert wd.check(time.monotonic() + 1.0)  # stall → window opens
+    wd.beat(0.005)
+    assert wd.check(time.monotonic() + 1.0)  # second stall: window spent
+    tc.step_completed(1)  # heartbeat after recovery closes the window
+    tc.close()
+    log.close()
+    events = report.load_events(str(tmp_path))
+    traces = [e for e in events if e["type"] == "trace"]
+    assert len(traces) == 1 and traces[0]["reason"] == "stall"
+    # ordering: the window opened before the stall record was written
+    types = [e["type"] for e in events]
+    assert types.index("stall") < types.index("trace")
+
+
+# ---------------------------------------------------------------------------
 # Compile tracking
 # ---------------------------------------------------------------------------
 
@@ -279,6 +419,22 @@ def test_compile_tracker_emits_with_shape_signature(tmp_path):
     assert backend[0]["shapes"] == {"image": [1, 6, 11, 3]}
 
 
+def test_compile_counter_tallies_backend_compiles():
+    """graftprof's per-bench-row compile accounting: the counter sees
+    the real XLA compiles in its window (no EventLog needed) and stops
+    counting once the window closes."""
+    import jax
+
+    with compile_track.count() as cc:
+        # tiny unique kernel — below the persistent-cache threshold, so
+        # it backend-compiles every run
+        jax.jit(lambda x: x * 1.618 + 0.577)(np.ones((3, 5), np.float32))
+    assert cc.n >= 1 and cc.seconds > 0
+    n_before = cc.n
+    jax.jit(lambda x: x * 2.718 - 1.414)(np.ones((3, 5), np.float32))
+    assert cc.n == n_before  # closed window: no further tallies
+
+
 # ---------------------------------------------------------------------------
 # report folding
 # ---------------------------------------------------------------------------
@@ -292,20 +448,29 @@ def _synthetic_events():
            batch_size=2, steps_per_epoch=4),
         mk("compile", phase="backend_compile", duration_ms=500.0,
            shapes=None),
+        # graftprof: per-bucket XLA cost accounting — flops chosen so the
+        # p50-20ms bucket lands at MFU 0.5 against the stamped peak
+        mk("cost", label="train_step", shapes={"image": [2, 8, 8, 3]},
+           peak_flops=1e12, flops=1e10, bytes_accessed=5e9,
+           hbm_bytes=2e9, hbm_args=1.5e9, hbm_temps=4e8, hbm_output=1e8,
+           hbm_alias=0.0),
         mk("step", step=1, epoch=0, batch=0, data_wait_ms=5.0,
-           step_ms=20.0),
+           step_ms=20.0, canvas=[8, 8], pad_waste=0.25),
         mk("step", step=2, epoch=0, batch=1, data_wait_ms=1.0,
-           step_ms=10.0),
+           step_ms=10.0, canvas=[8, 8], pad_waste=0.15),
         mk("step", step=2, epoch=0, batch=1, samples_per_sec=150.0,
            window=2),
         mk("compile", phase="backend_compile", duration_ms=300.0, step=2,
            shapes={"image": [1, 8, 8, 3]}),
         mk("compile", phase="jaxpr_trace", duration_ms=10.0, step=2),
         mk("step", step=3, epoch=0, batch=2, data_wait_ms=2.0,
-           step_ms=10.0),
+           step_ms=10.0, canvas=[8, 8], pad_waste=0.25),
         mk("step", step=4, epoch=0, batch=3, data_wait_ms=2.0,
-           step_ms=40.0),
-        mk("epoch", epoch=0, metrics={"TotalLoss": 1.0}),
+           step_ms=40.0, canvas=[8, 8], pad_waste=0.35),
+        mk("trace", dir="obs/trace/step2", reason="step 2",
+           summary={"phases": {"forward": 9.0, "host": 1.0},
+                    "total_ms": 10.0, "events": 4, "top_ops": []}),
+        mk("epoch", epoch=0, metrics={"TotalLoss": 1.0}, pad_waste=0.25),
         mk("checkpoint", epoch=1, prefix="p"),
         mk("eval", images=8, results={"mAP": 0.5}),
         mk("stall", waited_s=9.0),
@@ -330,10 +495,25 @@ def test_report_aggregates_synthetic_log():
     assert s["evals"] == [{"mAP": 0.5}]
     assert s["stalls"] == 1
     assert s["crash"]["step"] == 4
+    # graftprof folds: the cost bucket joins the canvas-matched steps
+    # (p50 20 ms at 1e10 flops against the stamped 1e12 peak → MFU 0.5)
+    assert len(s["cost"]["buckets"]) == 1
+    bucket = s["cost"]["buckets"][0]
+    assert bucket["canvas"] == [8, 8] and bucket["steps"] == 4
+    assert bucket["mfu"] == pytest.approx(0.5)
+    assert s["cost"]["mfu"] == pytest.approx(0.5)
+    assert s["cost"]["hbm_bytes"] == 2e9
+    assert s["pad_waste"] == pytest.approx(0.25)  # p50 of the step events
+    assert s["traces"][0]["reason"] == "step 2"
+    assert s["traces"][0]["summary"]["phases"]["forward"] == 9.0
     blob = report.bench_blob(s)
     assert blob["value"] == 150.0 and blob["compile_count"] == 2
     assert blob["stall_count"] == 1
     assert blob["data_wait_fraction"] == pytest.approx(0.125)
+    assert blob["mfu"] == pytest.approx(0.5)
+    assert blob["hbm_bytes"] == 2e9
+    assert blob["pad_waste"] == pytest.approx(0.25)
+    assert "mfu 0.5" in report.render(s)
     # derived-throughput fallback when no Speedometer window exists
     s2 = report.summarize([e for e in _synthetic_events()
                            if "samples_per_sec" not in e])
@@ -390,15 +570,30 @@ def _tiny_fit(tmp_path, prefix_name, **obs_overrides):
 @pytest.mark.compile_heavy
 def test_fit_detector_obs_enabled_and_report(tmp_path):
     """The acceptance gate: a short synthetic fit with obs enabled writes
-    a run_meta + per-step + epoch event stream, and the report CLI folds
-    it into throughput and compile-count fields."""
+    a run_meta + per-step + epoch event stream — including graftprof's
+    cost/trace/pad-waste layer — and the report CLI folds it into
+    throughput, compile-count, MFU and HBM fields."""
     obs_dir = tmp_path / "obsrun"
     params = _tiny_fit(tmp_path, "ckpt",
-                       **{"obs.enabled": True, "obs.dir": str(obs_dir)})
+                       **{"obs.enabled": True, "obs.dir": str(obs_dir),
+                          "obs.trace_at_step": 2, "obs.trace_steps": 1})
     assert params is not None
     events = report.load_events(str(obs_dir))
     types = {e["type"] for e in events}
-    assert {"run_meta", "step", "epoch", "checkpoint"} <= types
+    assert {"run_meta", "step", "epoch", "checkpoint", "cost",
+            "trace"} <= types
+
+    # graftprof: one cost event for the single shape bucket, with real
+    # XLA numbers behind the computed MFU
+    cost = next(e for e in events if e["type"] == "cost")
+    assert cost["flops"] > 0 and cost["hbm_bytes"] > 0
+    assert cost["peak_flops"] > 0
+    assert cost["shapes"]["image"] == [1, 128, 128, 3]
+    # the armed window closed and folded (128px images on a 128 canvas:
+    # pad_waste is an exact 0)
+    trace = next(e for e in events if e["type"] == "trace")
+    assert trace["reason"] == "step 2"
+    assert trace["summary"] is None or trace["summary"]["events"] > 0
 
     meta = next(e for e in events if e["type"] == "run_meta")
     assert meta["batch_size"] == 1 and meta["steps_per_epoch"] == 4
@@ -410,9 +605,13 @@ def test_fit_detector_obs_enabled_and_report(tmp_path):
     for e in timed:
         assert e["data_wait_ms"] >= 0 and e["step_ms"] > 0
         assert "dispatch_ms" in e
+        assert e["canvas"] == [128, 128]
+        assert e["pad_waste"] == 0.0  # 128px content on a 128 canvas
     epochs = [e for e in events if e["type"] == "epoch"]
     assert epochs[0]["epoch"] == 0
     assert "TotalLoss" in epochs[0]["metrics"]
+    assert epochs[0]["pad_waste"] == 0.0  # the loader's counters
+    assert epochs[0]["pad_canvas_px"] == 4 * 128 * 128
 
     # the report CLI (the artifact future BENCH/regression gates consume)
     out = tmp_path / "report.json"
@@ -430,6 +629,13 @@ def test_fit_detector_obs_enabled_and_report(tmp_path):
     assert blob["detail"]["epochs"] == 1
     assert blob["detail"]["checkpoints"] == 1
     assert blob["stall_count"] == 0
+    # graftprof: the folded blob carries the computed-cost fields the
+    # perf ledger gates (MFU rounds to 0.0 at CPU step times — present,
+    # not None, is the contract here)
+    assert blob["mfu"] is not None
+    assert blob["hbm_bytes"] > 0
+    assert blob["pad_waste"] == 0.0
+    assert blob["detail"]["cost"]["buckets"][0]["canvas"] == [128, 128]
 
 
 @pytest.mark.compile_heavy
