@@ -294,13 +294,47 @@ class ImageConfig:
     # multiple of the max feature stride. 1024 covers the (600,1000) scale.
     pad_shape: tuple = (1024, 1024)
     # Multi-scale training (BASELINE config 3): one (H, W) pad bucket per
-    # entry of `scales`. Used ONLY when len(pad_shapes) == len(scales)
-    # (so a test overriding scales alone falls back to pad_shape); each
-    # bucket is its own static shape → its own jit compile of the train
-    # step (documented cost: one extra compile per extra scale). The
-    # loader samples one scale PER BATCH — the per-image random scale of
-    # reference-lineage forks would break the single static batch shape.
+    # entry of `scales`. Used ONLY when len(pad_shapes) == len(scales);
+    # an EMPTY tuple falls back to the single pad_shape (the documented
+    # override path — generate_config empties it when scales/pad_shape
+    # are overridden alone), while a NON-empty length mismatch is a
+    # config error (loader.pad_shape_for raises — the stale-pair trap).
+    # Each bucket is its own static shape → its own jit compile of the
+    # train step (documented cost: one extra compile per extra scale).
+    # The loader samples one scale PER BATCH — the per-image random
+    # scale of reference-lineage forks would break the single static
+    # batch shape.
     pad_shapes: tuple = ()
+    # graftcanvas (data/canvas.py): whole-batch canvas packing. The
+    # loader shelf-packs every batch's mixed-size images into ONE fixed
+    # (canvas_shape) canvas per data shard instead of padding each image
+    # to its orientation x scale pad bucket — every STEP then has one
+    # static shape, period (the pad-bucket compile zoo collapses to a
+    # single train-step executable) and the model pays for canvas
+    # pixels, not bucket pixels. Placement metadata rides im_info
+    # ([h, w, scale, y0, x0] rows) through anchors/targets, proposals
+    # and ROI extraction, so per-image semantics are exact: proposals
+    # and ROIs never cross a placement border (gated in
+    # tests/test_canvas.py). TRAIN-time only — eval/checkpoints are
+    # unaffected. Default off until the on-chip A/B (bench.py
+    # c4_r101_canvas / fpn_r101_canvas recipes).
+    canvas_pack: bool = False
+    # Fixed canvas (H, W); () derives a never-overflowing cover from
+    # scales/canvas_images (data/canvas.py::resolve_canvas — the
+    # conservative default; set a TIGHT canvas for the pixel win and let
+    # scale-to-fit absorb the rare overflow batch).
+    canvas_shape: tuple = ()
+    # Minimum zero gap (px) between any two placements and alignment of
+    # every placement offset; 0 derives the model family's max feature
+    # stride (64 for FPN/ViTDet, 16 for C4). Must stay >= that stride:
+    # alignment keeps every downsampled grid exact and the gap keeps
+    # activations from leaking across images (the rpn_forward_packed
+    # zero-gap argument, per-block re-masked in the backbone).
+    canvas_gap: int = 0
+    # Images packed per canvas plane; 0 = train.batch_images (each data
+    # shard packs its whole per-device batch into one plane). Packing
+    # pays off at >= 2 images per plane — mixed aspects share a canvas.
+    canvas_images: int = 0
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,299 @@
+"""graftcanvas — host-side whole-batch canvas packing (planner + contract).
+
+Generalizes models/fpn.py::pack_placements (the shelf-packer that fused
+the five per-level RPN head convs into one, PERF.md round 5) from pyramid
+LEVELS to the BATCH: each training batch's mixed-size images are
+shelf-packed into one fixed-shape canvas per data shard, so every step
+compiles exactly ONE train-step shape — the orientation x scale pad-bucket
+zoo (loader.resolve_pad_bucket: up to 3 shapes per scale) collapses, and
+the model pays for canvas pixels instead of bucket pixels (the measured
+``pad_waste`` the graftprof counters track).
+
+Exactness contract (the rpn_forward_packed zero-gap argument, one level
+up): placement offsets are aligned to the model's max feature stride (so
+every downsampled grid lands on exact cells), placements are separated by
+at least one aligned gap of zeros, and the backbone re-zeros the gap cells
+after every residual block (models/backbones.py masks) — so an image's
+activations inside its placement equal the per-image padded forward's
+bit-for-bit under frozen-BN (every conv sees zeros beyond the content
+boundary, exactly like the bucketed canvas edge's implicit SAME padding).
+GroupNorm models are ACCEPTED with a documented approximation: GroupNorm
+pools statistics over the whole sample, so a packed plane shares stats
+across its images the same way the bucketed path already pools stats over
+its zero padding. Attention models (ViTDet) mix tokens across the canvas
+inside the ViT encoder (the pyramid is re-masked after the SFP neck);
+DETR has no per-image proposal path to thread placements through and is
+rejected.
+
+Overflow policy (scale-to-fit): a batch whose content cannot pack into
+the fixed canvas is uniformly downscaled by 0.9 steps until it fits —
+the canvas shape NEVER changes (one compiled shape is the whole point),
+and multi-scale training already randomizes scale, so the rare shrunken
+batch is a scale perturbation, not a semantic change. Size the canvas to
+the workload (image.canvas_shape) so this stays rare; the derived
+default (resolve_canvas) is a conservative never-overflow cover.
+
+Pure numpy/stdlib — runs in loader worker threads.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.logger import logger
+
+#: im_info row layout of a packed batch: [h, w, scale, y0, x0] per image
+#: ((h, w) content extent INSIDE the canvas, (y0, x0) its placement
+#: offset). Bucketed batches keep the classic 3-column [h, w, scale].
+PACKED_INFO_COLS = 5
+
+#: scale-to-fit shrink step and attempt cap (0.9^20 ~ 0.12 — a canvas
+#: needing more than that is a config error, not an unlucky batch).
+FIT_STEP = 0.9
+FIT_MAX_TRIES = 20
+
+
+def align_up(v: int, a: int) -> int:
+    return ((int(v) + a - 1) // a) * a
+
+
+def canvas_align_for(cfg: Config) -> int:
+    """The model family's max feature stride — placement offsets must be
+    multiples of it so every downsampled grid is exact (FPN/ViTDet build
+    a P2..P6 pyramid, stride 64 at P6; C4/VGG stop at stride 16)."""
+    if cfg.network.use_fpn or cfg.network.use_vit:
+        return 64
+    return 16
+
+
+def canvas_images_for(cfg: Config) -> int:
+    """Images packed per canvas plane (image.canvas_images, defaulting to
+    the per-device batch)."""
+    return int(cfg.image.canvas_images or cfg.train.batch_images)
+
+
+class CanvasSpec:
+    """Resolved packing geometry: shape, gap/alignment, images per plane."""
+
+    def __init__(self, shape: Tuple[int, int], gap: int, align: int,
+                 images: int):
+        self.shape = (int(shape[0]), int(shape[1]))
+        self.gap = int(gap)
+        self.align = int(align)
+        self.images = int(images)
+
+    def __repr__(self):
+        return (f"CanvasSpec(shape={self.shape}, gap={self.gap}, "
+                f"align={self.align}, images={self.images})")
+
+
+def resolve_canvas(cfg: Config) -> CanvasSpec:
+    """image.canvas_* knobs → CanvasSpec, deriving what is unset.
+
+    Derived canvas (canvas_shape=()): a conservative vertical stack of
+    `canvas_images` worst-case SHORT-side slots over the widest scale —
+    (ipp * align(max_short + gap), align(max_long + gap)). It never
+    overflows on landscape batches and holds one max portrait whenever
+    ipp * slot >= the long side (true at ipp >= 2 for the COCO recipes);
+    tighter canvases — the actual pixel win — are a per-workload
+    image.canvas_shape choice with scale-to-fit absorbing the tail."""
+    align = int(cfg.image.canvas_gap) or canvas_align_for(cfg)
+    gap = align  # one aligned slot row/col of guaranteed zeros
+    ipp = canvas_images_for(cfg)
+    if cfg.image.canvas_shape:
+        ch, cw = cfg.image.canvas_shape
+    else:
+        max_short = max(s[0] for s in cfg.image.scales)
+        max_long = max(s[1] for s in cfg.image.scales)
+        ch = ipp * align_up(max_short + gap, align)
+        cw = align_up(max_long + gap, align)
+        if ch < max_long:
+            logger.warning(
+                "canvas_pack: derived canvas %dx%d cannot hold a "
+                "max-size portrait (long side %d) unscaled — portrait "
+                "batches will scale-to-fit. Set image.canvas_shape for "
+                "the workload's mix", ch, cw, max_long)
+    return CanvasSpec((ch, cw), gap, align, ipp)
+
+
+def validate_canvas_pack(cfg: Config) -> CanvasSpec:
+    """The canvas_pack config contract — raise early, with the real cause
+    (cfg-contract family), instead of failing mid-epoch in a worker
+    thread or silently training different semantics.
+
+    Norms: "frozen_bn" is the exact case (per-channel affine, re-masked
+    gaps — see module docstring). "group" is ACCEPTED: GroupNorm already
+    pools its per-sample statistics over the bucketed path's zero
+    padding, so pooling over a shared canvas is the same class of
+    approximation, and rejecting it would break every from-scratch
+    recipe (--from-scratch flips norm to GroupNorm — the known breakage
+    this validate must not reintroduce; regression-gated in
+    tests/test_canvas.py)."""
+    if not cfg.image.canvas_pack:
+        raise ValueError("validate_canvas_pack called with "
+                         "image.canvas_pack=False")
+    if cfg.network.use_detr:
+        raise ValueError(
+            "image.canvas_pack does not support DETR: set prediction has "
+            "no proposal path to thread placement borders through, and "
+            "its global encoder attention mixes packed images freely. "
+            "Disable canvas_pack for network.use_detr configs")
+    if cfg.network.norm not in ("frozen_bn", "group"):
+        raise ValueError(
+            f"image.canvas_pack: unknown network.norm {cfg.network.norm!r} "
+            "— packing is exact for 'frozen_bn' and a documented "
+            "approximation for 'group' (canvas-pooled statistics); other "
+            "norms have no analyzed packing semantics")
+    if cfg.network.norm == "group":
+        logger.info(
+            "canvas_pack with GroupNorm: per-sample statistics pool over "
+            "the shared canvas (same approximation class as the bucketed "
+            "path's zero-padding already in the stats); frozen_bn is the "
+            "exact case")
+    if cfg.network.use_vit:
+        logger.info(
+            "canvas_pack with ViTDet: the ViT encoder attends across the "
+            "canvas (windows/global blocks may span placements); the SFP "
+            "pyramid is re-masked and the proposal/ROI path stays "
+            "border-exact")
+    if not cfg.network.use_fpn and not cfg.network.use_vit \
+            and cfg.network.roi_pool_type != "align":
+        raise ValueError(
+            "image.canvas_pack needs network.roi_pool_type='align': the "
+            "quantized max-pool path has no per-placement sample-clamp "
+            "window support")
+    spec = resolve_canvas(cfg)
+    align = canvas_align_for(cfg)
+    if spec.align <= 0 or spec.align % align:
+        raise ValueError(
+            f"image.canvas_gap={cfg.image.canvas_gap} must be a positive "
+            f"multiple of the model's max feature stride ({align}) — "
+            "placement offsets must land on exact cells of every pyramid "
+            "level, with at least one empty cell between placements")
+    ch, cw = spec.shape
+    if ch % align or cw % align:
+        raise ValueError(
+            f"image.canvas_shape {spec.shape} must be a multiple of the "
+            f"max feature stride ({align}) in both dims")
+    if cfg.network.use_vit:
+        tile = cfg.network.vit_patch * cfg.network.vit_window
+        if ch % tile or cw % tile:
+            raise ValueError(
+                f"image.canvas_shape {spec.shape} must be a multiple of "
+                f"patch*window ({tile}) for the ViT windowed attention")
+    if cfg.train.batch_images % spec.images:
+        raise ValueError(
+            f"image.canvas_images={spec.images} must divide "
+            f"train.batch_images={cfg.train.batch_images} (whole planes "
+            "per device)")
+    # Every scale's SHORT side must fit unscaled in both dims, or every
+    # single batch of that scale pays the scale-to-fit shrink — that is
+    # a mis-sized canvas, not a tail case.
+    for t, _m in cfg.image.scales:
+        if t > min(ch, cw):
+            raise ValueError(
+                f"image.canvas_shape {spec.shape} is smaller than scale "
+                f"short side {t} — every batch would scale-to-fit; size "
+                "the canvas for the workload")
+    return spec
+
+
+def content_size(height: int, width: int, target: int, max_size: int
+                 ) -> Tuple[int, int, float]:
+    """(h, w, scale) after the reference resize rule — bit-identical to
+    data/image.py::resize_image's arithmetic so planned placements match
+    loaded pixels exactly."""
+    short, long = min(height, width), max(height, width)
+    scale = float(target) / short
+    if round(scale * long) > max_size:
+        scale = float(max_size) / long
+    return int(round(height * scale)), int(round(width * scale)), scale
+
+
+def plan_plane(sizes: Sequence[Tuple[int, int]], canvas: Tuple[int, int],
+               gap: int, align: int
+               ) -> Optional[List[Tuple[int, int]]]:
+    """Shelf-pack (h, w) rects into one fixed canvas; offsets aligned.
+
+    First-fit-decreasing by height (the pack_placements greedy, with a
+    fixed canvas width and an explicit fit check). Returns per-input
+    (y0, x0) offsets in INPUT order, or None when the batch does not fit.
+    Every offset is a multiple of `align` and any two rects are separated
+    by >= gap zeros (slot advance = align_up(extent + gap))."""
+    ch, cw = canvas
+    order = sorted(range(len(sizes)), key=lambda i: -sizes[i][0])
+    out: List[Optional[Tuple[int, int]]] = [None] * len(sizes)
+    shelf_y = 0   # top row of the current shelf
+    shelf_h = 0   # aligned slot height of the tallest rect on it
+    cur_x = 0
+    for i in order:
+        h, w = sizes[i]
+        if h > ch or w > cw:
+            return None
+        if cur_x > 0 and cur_x + w > cw:  # start a new shelf
+            shelf_y += shelf_h
+            shelf_h, cur_x = 0, 0
+        if shelf_y + h > ch:
+            return None
+        out[i] = (shelf_y, cur_x)
+        shelf_h = max(shelf_h, align_up(h + gap, align))
+        cur_x += align_up(w + gap, align)
+    return out  # type: ignore[return-value]
+
+
+def plan_batch(sizes_fn, n_images: int, spec: CanvasSpec
+               ) -> Tuple[List[Tuple[int, int, int]], float,
+                          List[Tuple[int, int]]]:
+    """Place a batch's content rects into fixed-canvas planes.
+
+    sizes_fn(fit) -> per-image (h, w) content sizes at scale-to-fit
+    factor `fit` (the loader computes these with the SAME resize
+    arithmetic the load path uses, so planned rects match loaded pixels
+    exactly). Images group into consecutive planes of spec.images.
+    Returns (placements, fit, sizes): placements[i] = (plane, y0, x0),
+    fit <= 1.0 the uniform factor actually used (1.0 almost always;
+    each shrink step is logged), sizes the planned content sizes at that
+    fit. Raises when even the floor factor cannot pack — a mis-sized
+    canvas, not an unlucky batch.
+    """
+    ipp = spec.images
+    assert n_images % ipp == 0, (n_images, ipp)
+    fit = 1.0
+    sizes: List[Tuple[int, int]] = []
+    for _ in range(FIT_MAX_TRIES):
+        sizes = list(sizes_fn(fit))
+        placements: List[Tuple[int, int, int]] = []
+        ok = True
+        for plane in range(n_images // ipp):
+            offs = plan_plane(sizes[plane * ipp:(plane + 1) * ipp],
+                              spec.shape, spec.gap, spec.align)
+            if offs is None:
+                ok = False
+                break
+            placements.extend((plane, y, x) for y, x in offs)
+        if ok:
+            if fit < 1.0:
+                logger.info(
+                    "canvas_pack: batch scaled-to-fit by %.3f (canvas %s, "
+                    "%d images/plane) — size image.canvas_shape up if this "
+                    "recurs", fit, spec.shape, ipp)
+            return placements, fit, sizes
+        last_fit = fit
+        fit *= FIT_STEP
+    raise ValueError(
+        f"canvas_pack: batch of {n_images} images (sizes {sizes} at fit "
+        f"{last_fit:.3f}, the smallest attempted) cannot pack into canvas "
+        f"{spec.shape} — image.canvas_shape is mis-sized for the workload")
+
+
+def packed_strides(cfg: Config) -> Tuple[int, ...]:
+    """Feature strides the placement masks are built at (ops/canvas.py):
+    every point the backbone/neck re-zeros gap cells."""
+    if cfg.network.use_vit:
+        return (4, 8, 16, 32, 64)  # SFP pyramid levels P2..P6
+    if cfg.network.use_fpn:
+        return (2, 4, 8, 16, 32)   # stem + C2..C5 (+ neck reuse)
+    if cfg.network.name == "vgg":
+        return (1, 2, 4, 8, 16)
+    return (2, 4, 8, 16)           # C4 stem + stages 1-3

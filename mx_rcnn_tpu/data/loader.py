@@ -37,13 +37,26 @@ from mx_rcnn_tpu.data.image import (
 
 
 def pad_shape_for(cfg: Config, scale_idx: int) -> tuple:
-    """The static pad bucket for scale `scale_idx`: image.pad_shapes when it
-    matches image.scales entry-for-entry, else the single image.pad_shape
-    (so overriding scales alone never silently pairs with stale buckets).
+    """The static pad bucket for scale `scale_idx`: image.pad_shapes when
+    present (must then match image.scales entry-for-entry), else the
+    single image.pad_shape.
+
+    An EMPTY pad_shapes is the documented fallback path (generate_config
+    empties it when scales/pad_shape are overridden alone). A NON-empty
+    length mismatch is the stale-pair trap — scales overridden next to
+    leftover buckets would silently train under-/over-padded — and is a
+    loud config error (cfg-contract family), not a silent fallback.
 
     A pad_shapes entry is stored LANDSCAPE-oriented ((H, W), H <= W);
     resolve_pad_bucket orients it per batch."""
-    if len(cfg.image.pad_shapes) == len(cfg.image.scales):
+    n = len(cfg.image.pad_shapes)
+    if n and n != len(cfg.image.scales):
+        raise ValueError(
+            f"image.pad_shapes has {n} entries but image.scales has "
+            f"{len(cfg.image.scales)} — the lists pair entry-for-entry. "
+            "Override them together, or set image.pad_shapes=() to fall "
+            "back to the single image.pad_shape")
+    if n:
         return tuple(cfg.image.pad_shapes[scale_idx])
     return tuple(cfg.image.pad_shape)
 
@@ -101,6 +114,48 @@ def _load_roidb_entry(entry: Dict, cfg: Config, scale_idx: int = 0,
         img = pad_image(
             transform_image(img, cfg.image.pixel_means,
                             cfg.image.pixel_stds), pad)
+    im_info = np.asarray([h, w, scale], np.float32)
+    return img, im_info, boxes, entry["gt_classes"].astype(np.int32)
+
+
+def _load_roidb_content(entry: Dict, cfg: Config, scale_idx: int,
+                        fit: float = 1.0):
+    """roidb record → (normalized UNPADDED image, im_info [h, w, scale],
+    boxes, classes) at the drawn scale × the scale-to-fit factor — the
+    graftcanvas packed path's load: the batch assembler places the raw
+    content into a shared canvas instead of padding per image.
+
+    Packed entries take the mmap fast path (data/packed.py
+    load_packed_content): the stored content slice feeds the placement
+    directly; only a fit < 1 batch pays a second resample."""
+    if "packed" in entry:
+        from mx_rcnn_tpu.data.packed import load_packed_content
+
+        return load_packed_content(entry, cfg, scale_idx, fit)
+    if "image_data" in entry:
+        img = entry["image_data"].astype(np.float32)
+    else:
+        img = load_image(entry["image"])
+    boxes = entry["boxes"].astype(np.float32).copy()
+    if entry.get("flipped"):
+        img, boxes = flip_image_and_boxes(img, boxes)
+    target, max_size = cfg.image.scales[scale_idx]
+    if fit < 1.0:
+        target = max(1, int(round(target * fit)))
+        max_size = max(1, int(round(max_size * fit)))
+    img, scale = resize_image(img, target, max_size)
+    boxes *= scale
+    h, w = img.shape[:2]
+    # Fused GIL-free normalize (cc/imgproc.c) with pad == content dims
+    # (a no-op pad keeps the one-pass kernel); numpy fallback.
+    from mx_rcnn_tpu.data._native_img import normalize_pad
+
+    fused = normalize_pad(np.ascontiguousarray(img, np.float32),
+                          cfg.image.pixel_means, cfg.image.pixel_stds,
+                          (h, w))
+    img = (fused if fused is not None else
+           transform_image(img, cfg.image.pixel_means,
+                           cfg.image.pixel_stds))
     im_info = np.asarray([h, w, scale], np.float32)
     return img, im_info, boxes, entry["gt_classes"].astype(np.int32)
 
@@ -313,6 +368,14 @@ class AnchorLoader(_CloseableLoader):
     Yields dicts with keys image (B,H,W,3) f32, im_info (B,3),
     gt_boxes (B,G,4), gt_classes (B,G), gt_valid (B,G) — the forward_train
     batch contract. B = cfg.train.batch_images × num_shards (devices).
+
+    graftcanvas (cfg.image.canvas_pack): batches are instead PACKED —
+    each shard's images shelf-packed into one fixed canvas plane
+    (data/canvas.py planner), yielding image (P,Hc,Wc,3) + im_info
+    (P,I,5) placement rows + (P,I,G,·) canvas-coordinate gt tensors (the
+    ops/canvas.py contract). Every batch of every scale draw then has
+    the SAME shape — one compiled train step, period — and the pad
+    counters below measure canvas utilization instead of bucket waste.
     """
 
     def __init__(self, roidb: List[Dict], cfg: Config, num_shards: int = 1,
@@ -336,6 +399,11 @@ class AnchorLoader(_CloseableLoader):
         self._rng = np.random.RandomState(seed)
         self._depth = prefetch_depth
         self._workers = workers
+        self._canvas_spec = None
+        if cfg.image.canvas_pack:
+            from mx_rcnn_tpu.data.canvas import validate_canvas_pack
+
+            self._canvas_spec = validate_canvas_pack(cfg)
 
     def __len__(self):
         return len(self.roidb) // self.global_batch_size
@@ -383,9 +451,112 @@ class AnchorLoader(_CloseableLoader):
         self._rng.shuffle(inds)
         return inds
 
+    def _content_sizes_fn(self, idxs, scale_idx):
+        """sizes_fn for the canvas planner: per-image content (h, w) at
+        the drawn scale × fit, via the SAME arithmetic the load path
+        uses (data/canvas.py::content_size; packed entries read their
+        stored post-resize dims) — planned rects match loaded pixels."""
+        from mx_rcnn_tpu.data.canvas import content_size
+
+        cfg = self.cfg
+        target0, max0 = cfg.image.scales[scale_idx]
+
+        def sizes_at(fit):
+            if fit < 1.0:
+                t = max(1, int(round(target0 * fit)))
+                mx = max(1, int(round(max0 * fit)))
+            else:
+                t, mx = target0, max0
+            out = []
+            for i in idxs:
+                e = self.roidb[i]
+                if "packed" in e:
+                    ref = e["packed"].get(scale_idx)
+                    if ref is None:
+                        # Same remediation hint as load_packed_content —
+                        # the planner runs BEFORE any load, so the error
+                        # must be raised (descriptively) here too.
+                        raise ValueError(
+                            f"scale_idx {scale_idx} is not packed (have "
+                            f"{sorted(e['packed'])}); re-pack with "
+                            "write_packed_dataset covering every "
+                            "training scale")
+                    rh, rw = ref["hw"]
+                    out.append((rh, rw) if fit >= 1.0
+                               else content_size(rh, rw, t, mx)[:2])
+                    continue
+                if "image_data" in e:
+                    h0, w0 = e["image_data"].shape[:2]
+                else:
+                    h0, w0 = e["height"], e["width"]
+                out.append(content_size(h0, w0, t, mx)[:2])
+            return out
+
+        return sizes_at
+
+    def _make_packed_batch(self, idxs, scale_idx) -> Dict[str, np.ndarray]:
+        """graftcanvas batch assembly: plan placements (scale-to-fit on
+        overflow), load unpadded content, place into fixed canvas
+        planes, shift gt boxes to canvas coordinates."""
+        from mx_rcnn_tpu.data.canvas import plan_batch
+
+        cfg = self.cfg
+        spec = self._canvas_spec
+        g = cfg.train.max_gt_boxes
+        with_masks = cfg.network.use_mask
+        m = cfg.train.mask_gt_resolution
+        ch, cw = spec.shape
+        placements, fit, _ = plan_batch(
+            self._content_sizes_fn(idxs, scale_idx), len(idxs), spec)
+        planes = len(idxs) // spec.images
+        image = np.zeros((planes, ch, cw, 3), np.float32)
+        info = np.zeros((planes, spec.images, 5), np.float32)
+        gtb = np.zeros((planes, spec.images, g, 4), np.float32)
+        gtc = np.zeros((planes, spec.images, g), np.int32)
+        gtv = np.zeros((planes, spec.images, g), bool)
+        gtm = (np.zeros((planes, spec.images, g, m, m), np.uint8)
+               if with_masks else None)
+        real_px = 0.0
+        for j, i in enumerate(idxs):
+            entry = self.roidb[i]
+            img, iminfo, boxes, classes = _load_roidb_content(
+                entry, cfg, scale_idx, fit)
+            pl, y0, x0 = placements[j]
+            slot = j % spec.images
+            # Clamp into the canvas: a fit<1 double-resample can round a
+            # pixel past the plan; the slot's gap margin absorbs it.
+            h = min(img.shape[0], ch - y0)
+            w = min(img.shape[1], cw - x0)
+            image[pl, y0:y0 + h, x0:x0 + w] = img[:h, :w]
+            if len(boxes):
+                boxes = boxes + np.asarray([x0, y0, x0, y0], np.float32)
+            b_, c_, v_ = _pad_gt(boxes, classes, g)
+            info[pl, slot] = (h, w, iminfo[2], y0, x0)
+            gtb[pl, slot] = b_
+            gtc[pl, slot] = c_
+            gtv[pl, slot] = v_
+            if with_masks:
+                gtm[pl, slot] = _entry_gt_masks(entry, m, g)
+            real_px += float(h) * float(w)
+        batch = {
+            "image": image,
+            "im_info": info,
+            "gt_boxes": gtb,
+            "gt_classes": gtc,
+            "gt_valid": gtv,
+        }
+        if with_masks:
+            batch["gt_masks"] = gtm
+        # graftprof: in packed mode the counters measure CANVAS
+        # utilization — real content pixels over compiled canvas pixels.
+        self._note_pad(real_px, planes * ch * cw)
+        return batch
+
     def _make_batch(self, item) -> Dict[str, np.ndarray]:
         idxs, scale_idx = item
         cfg = self.cfg
+        if self._canvas_spec is not None:
+            return self._make_packed_batch(idxs, scale_idx)
         g = cfg.train.max_gt_boxes
         with_masks = cfg.network.use_mask
         m = cfg.train.mask_gt_resolution
@@ -451,6 +622,12 @@ class ROIIter(AnchorLoader):
 
     def __init__(self, roidb: List[Dict], cfg: Config, num_shards: int = 1,
                  max_proposals: int = 2000, **kw):
+        if cfg.image.canvas_pack:
+            raise NotImplementedError(
+                "image.canvas_pack is not supported by ROIIter: "
+                "precomputed proposals would need placement shifting and "
+                "the Fast-RCNN stage forward runs bucketed. Disable "
+                "canvas_pack for alternate-stage training")
         super().__init__(roidb, cfg, num_shards, **kw)
         self.max_proposals = max_proposals
 

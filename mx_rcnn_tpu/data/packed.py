@@ -251,3 +251,62 @@ def load_packed_entry(entry: Dict, cfg: Config, scale_idx: int,
             pad)
     im_info = np.asarray([rh, rw, scale], np.float32)
     return img, im_info, boxes, entry["gt_classes"].astype(np.int32)
+
+
+def load_packed_content(entry: Dict, cfg: Config, scale_idx: int,
+                        fit: float = 1.0):
+    """graftcanvas analog of load_packed_entry: mmap slice → f32 →
+    normalize, UNPADDED — the content feeds a canvas placement directly
+    (data/loader.py::_make_packed_batch), so the pack-time decode+resize
+    is all the geometry work the hot path pays. fit < 1 (a scale-to-fit
+    batch) re-resamples the stored content to the shrunken targets —
+    rare by construction; the planner logs it.
+
+    Returns (img f32 HWC unpadded, im_info [h, w, scale], boxes,
+    classes) with `scale` the ORIGINAL-image → content scale (stored
+    pack scale × any fit resample)."""
+    from mx_rcnn_tpu.data.image import resize_image, transform_image
+
+    ref = entry["packed"].get(scale_idx)
+    if ref is None:
+        raise ValueError(
+            f"scale_idx {scale_idx} is not packed (have "
+            f"{sorted(entry['packed'])}); re-pack with "
+            "write_packed_dataset covering every training scale")
+    from mx_rcnn_tpu.data._native_img import normalize_pad
+
+    rh, rw = ref["hw"]
+    scale = ref["scale"]
+    img_u8 = np.asarray(_shard_mmap(ref["file"])[ref["index"], :rh, :rw])
+    boxes = entry["boxes"].astype(np.float32).copy()
+    flipped = bool(entry.get("flipped"))
+    if flipped:
+        w0 = entry["width"]
+        x1 = boxes[:, 0].copy()
+        boxes[:, 0] = w0 - boxes[:, 2] - 1
+        boxes[:, 2] = w0 - x1 - 1
+    if fit < 1.0:
+        target, max_size = cfg.image.scales[scale_idx]
+        arr = (img_u8[:, ::-1] if flipped else img_u8).astype(np.float32)
+        arr, s2 = resize_image(arr, max(1, int(round(target * fit))),
+                               max(1, int(round(max_size * fit))))
+        scale *= s2
+        img = normalize_pad(np.ascontiguousarray(arr, np.float32),
+                            cfg.image.pixel_means, cfg.image.pixel_stds,
+                            arr.shape[:2])
+        if img is None:
+            img = transform_image(arr, cfg.image.pixel_means,
+                                  cfg.image.pixel_stds)
+    else:
+        # Fused u8→f32 mirror+normalize (cc/imgproc.c), pad == content
+        # dims — the same one-pass kernel the bucketed mmap path uses.
+        img = normalize_pad(img_u8, cfg.image.pixel_means,
+                            cfg.image.pixel_stds, (rh, rw), flip=flipped)
+        if img is None:
+            arr = (img_u8[:, ::-1] if flipped else img_u8)
+            img = transform_image(arr.astype(np.float32),
+                                  cfg.image.pixel_means,
+                                  cfg.image.pixel_stds)
+    boxes *= scale
+    im_info = np.asarray([img.shape[0], img.shape[1], scale], np.float32)
+    return img, im_info, boxes, entry["gt_classes"].astype(np.int32)

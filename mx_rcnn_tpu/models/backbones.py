@@ -81,7 +81,18 @@ def make_norm(norm: str, features: int, dtype: Dtype, name: str):
 
 
 class Bottleneck(nn.Module):
-    """ResNet v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1, post-activation."""
+    """ResNet v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1, post-activation.
+
+    graftcanvas masks: `mask_in` (input stride) re-zeros packed-canvas
+    gap cells on the 3x3 conv's INPUT — the 1x1 conv + norm turn masked
+    zeros into a bias value (frozen-BN beta, GroupNorm bias), and the
+    3x3 is the block's only cross-cell read, so masking exactly there
+    makes every spatial window see zeros beyond the content boundary,
+    identical to the bucketed path's implicit SAME padding. `mask_out`
+    (output stride) re-zeros the block output so the NEXT cross-cell
+    consumer (the following block's 3x3, the RPN head, ROIAlign border
+    taps) reads clean gaps too. None = no-op (bucketed path HLO
+    unchanged)."""
 
     filters: int  # inner width; output is 4*filters
     stride: int = 1
@@ -89,13 +100,16 @@ class Bottleneck(nn.Module):
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, mask_in=None,
+                 mask_out=None) -> jnp.ndarray:
         needs_proj = x.shape[-1] != self.filters * 4 or self.stride != 1
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
                     param_dtype=jnp.float32, name="conv1")(x)
         y = make_norm(self.norm, self.filters, self.dtype, "bn1")(y)
         y = nn.relu(y)
+        if mask_in is not None:
+            y = y * mask_in.astype(y.dtype)
         y = nn.Conv(self.filters, (3, 3), strides=(self.stride, self.stride),
                     padding=[(1, 1), (1, 1)], use_bias=False, dtype=self.dtype,
                     param_dtype=jnp.float32, name="conv2")(y)
@@ -111,10 +125,21 @@ class Bottleneck(nn.Module):
                                param_dtype=jnp.float32, name="downsample_conv")(x)
             residual = make_norm(self.norm, self.filters * 4, self.dtype,
                                  "downsample_bn")(residual)
-        return nn.relu(y + residual)
+        out = nn.relu(y + residual)
+        if mask_out is not None:
+            out = out * mask_out.astype(out.dtype)
+        return out
 
 
 class ResNetStage(nn.Module):
+    """graftcanvas masks (ops/canvas.py::placement_masks): `mask_in` at
+    the stage's INPUT stride and `mask` at its OUTPUT stride. Block 0
+    (which may downsample) reads mask_in for its 3x3 input, every later
+    block the output-stride mask; all blocks re-zero their outputs
+    (Bottleneck.mask_out) so packed-canvas gap cells stay exactly zero
+    through the stage. None = no-op (identical HLO to the pre-canvas
+    code)."""
+
     blocks: int
     filters: int
     stride: int
@@ -122,11 +147,12 @@ class ResNetStage(nn.Module):
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, mask_in=None, mask=None) -> jnp.ndarray:
         for i in range(self.blocks):
             x = Bottleneck(self.filters, stride=self.stride if i == 0 else 1,
                            norm=self.norm, dtype=self.dtype,
-                           name=f"block{i}")(x)
+                           name=f"block{i}")(x, mask_in if i == 0 else mask,
+                                             mask)
         return x
 
 
@@ -146,8 +172,15 @@ class ResNetC4(nn.Module):
     remat: bool = False  # rematerialize stage activations in the backward
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, masks=None) -> jnp.ndarray:
+        """masks (graftcanvas): {stride: (B, H/s, W/s, 1)} placement
+        masks of a packed canvas; gap cells are re-zeroed after the stem
+        (before AND after the max-pool — the pool's post-relu window max
+        over zero gap cells matches the bucketed -inf edge padding only
+        when its inputs are masked) and after every residual block
+        (ResNetStage.mask). None = the classic bucketed path."""
         blocks = STAGE_BLOCKS[self.depth]
+        m = masks or {}
         # jax.checkpoint per stage: trades ~1/3 extra FLOPs for not keeping
         # every block's activations live through the backward — the HBM
         # lever for big images / batch > 1 (network.remat).
@@ -158,17 +191,21 @@ class ResNetC4(nn.Module):
                     name="conv0")(x)
         x = make_norm(self.norm, 64, self.dtype, "bn0")(x)
         x = nn.relu(x)
+        if 2 in m:
+            x = x * m[2].astype(x.dtype)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        if 4 in m:
+            x = x * m[4].astype(x.dtype)
         if self.freeze_at >= 1:
             x = jax.lax.stop_gradient(x)
         x = Stage(blocks[0], 64, stride=1, norm=self.norm,
-                  dtype=self.dtype, name="stage1")(x)
+                  dtype=self.dtype, name="stage1")(x, m.get(4), m.get(4))
         if self.freeze_at >= 2:
             x = jax.lax.stop_gradient(x)
         x = Stage(blocks[1], 128, stride=2, norm=self.norm,
-                  dtype=self.dtype, name="stage2")(x)
+                  dtype=self.dtype, name="stage2")(x, m.get(4), m.get(8))
         x = Stage(blocks[2], 256, stride=2, norm=self.norm,
-                  dtype=self.dtype, name="stage3")(x)
+                  dtype=self.dtype, name="stage3")(x, m.get(8), m.get(16))
         return x  # (B, H/16, W/16, 1024)
 
 
@@ -185,8 +222,10 @@ class ResNetStages(nn.Module):
     remat: bool = False  # see ResNetC4.remat
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> Sequence[jnp.ndarray]:
+    def __call__(self, x: jnp.ndarray, masks=None) -> Sequence[jnp.ndarray]:
+        """masks: packed-canvas placement masks (see ResNetC4)."""
         blocks = STAGE_BLOCKS[self.depth]
+        m = masks or {}
         Stage = nn.remat(ResNetStage) if self.remat else ResNetStage
         x = x.astype(self.dtype)
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
@@ -194,19 +233,23 @@ class ResNetStages(nn.Module):
                     name="conv0")(x)
         x = make_norm(self.norm, 64, self.dtype, "bn0")(x)
         x = nn.relu(x)
+        if 2 in m:
+            x = x * m[2].astype(x.dtype)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        if 4 in m:
+            x = x * m[4].astype(x.dtype)
         if self.freeze_at >= 1:
             x = jax.lax.stop_gradient(x)
         c2 = Stage(blocks[0], 64, stride=1, norm=self.norm,
-                   dtype=self.dtype, name="stage1")(x)
+                   dtype=self.dtype, name="stage1")(x, m.get(4), m.get(4))
         if self.freeze_at >= 2:
             c2 = jax.lax.stop_gradient(c2)
         c3 = Stage(blocks[1], 128, stride=2, norm=self.norm,
-                   dtype=self.dtype, name="stage2")(c2)
+                   dtype=self.dtype, name="stage2")(c2, m.get(4), m.get(8))
         c4 = Stage(blocks[2], 256, stride=2, norm=self.norm,
-                   dtype=self.dtype, name="stage3")(c3)
+                   dtype=self.dtype, name="stage3")(c3, m.get(8), m.get(16))
         c5 = Stage(blocks[3], 512, stride=2, norm=self.norm,
-                   dtype=self.dtype, name="stage4")(c4)
+                   dtype=self.dtype, name="stage4")(c4, m.get(16), m.get(32))
         return c2, c3, c4, c5
 
 
@@ -244,17 +287,26 @@ class VGGConv(nn.Module):
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, masks=None) -> jnp.ndarray:
+        """masks: packed-canvas placement masks (see ResNetC4). VGG convs
+        carry biases, so gap cells are re-zeroed after EVERY conv — a
+        biased conv turns zeros into a bias halo that the next conv
+        would read where the bucketed path reads implicit zero pad."""
         plan = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+        m = masks or {}
         x = x.astype(self.dtype)
+        stride = 1
         for b, (n_convs, width) in enumerate(plan, start=1):
             for c in range(1, n_convs + 1):
                 x = nn.Conv(width, (3, 3), padding=[(1, 1), (1, 1)],
                             dtype=self.dtype, param_dtype=jnp.float32,
                             name=f"conv{b}_{c}")(x)
                 x = nn.relu(x)
+                if stride in m:
+                    x = x * m[stride].astype(x.dtype)
             if b < 5:  # no pool5 — keep stride 16
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                stride *= 2
             if b == self.freeze_blocks:
                 x = jax.lax.stop_gradient(x)
         return x  # (B, H/16, W/16, 512)

@@ -87,8 +87,11 @@ class FasterRCNN(nn.Module):
             self.num_classes * 4, dtype=self.dtype, param_dtype=jnp.float32,
             kernel_init=nn.initializers.normal(0.001), name="bbox_pred")
 
-    def extract(self, images: jnp.ndarray) -> jnp.ndarray:
-        return self.features(images)
+    def extract(self, images: jnp.ndarray, masks=None) -> jnp.ndarray:
+        """masks (graftcanvas): {stride: (B, H/s, W/s, 1)} packed-canvas
+        placement masks the backbone re-zeros its gap cells with
+        (models/backbones.py). None = the classic bucketed path."""
+        return self.features(images, masks)
 
     def rpn_forward(self, feat: jnp.ndarray):
         return self.rpn(feat)
@@ -140,27 +143,36 @@ def _pair_logits(cls_logits: jnp.ndarray, num_anchors: int) -> jnp.ndarray:
     return jnp.stack([bg, fg], axis=-1)
 
 
-def _pool_rois(feat, rois, roi_valid, pool_size, pool_type):
+def _pool_rois(feat, rois, roi_valid, pool_size, pool_type,
+               plane_of=None, windows=None):
     """Batched ROI pooling: (B,Hf,Wf,C) + (B,R,4) → (B·R,P,P,C).
 
     Builds the (batch_idx, x1..y2) 5-vector layout the pooling ops share with
     the reference's ROIPooling input convention.
+
+    graftcanvas: on a packed batch `feat` holds PLANES — `plane_of` (B,)
+    maps each image row to its plane, `windows` (B, 4) [y0, x0, h, w]
+    clamps border samples to the image's own cells (ops/roi_align.py).
     """
     b, r = rois.shape[0], rois.shape[1]
-    batch_idx = jnp.repeat(jnp.arange(b, dtype=jnp.float32), r)[:, None]
+    ids = (jnp.arange(b, dtype=jnp.float32) if plane_of is None
+           else plane_of.astype(jnp.float32))
+    batch_idx = jnp.repeat(ids, r)[:, None]
     flat = jnp.concatenate([batch_idx, rois.reshape(b * r, 4)], axis=1)
     if pool_type == "align":
-        pooled = roi_align(feat, flat, pool_size, 1.0 / 16.0)
+        win = None if windows is None else jnp.repeat(windows, r, axis=0)
+        pooled = roi_align(feat, flat, pool_size, 1.0 / 16.0, windows=win)
     else:
         pooled = roi_pool(feat, flat, pool_size, 1.0 / 16.0)
     # Zero padded slots so dead rois contribute nothing downstream.
     return pooled * roi_valid.reshape(b * r, 1, 1, 1).astype(pooled.dtype)
 
 
-def _backbone_rpn(model: FasterRCNN, params, images: jnp.ndarray, cfg: Config):
+def _backbone_rpn(model: FasterRCNN, params, images: jnp.ndarray, cfg: Config,
+                  masks=None):
     """Shared preamble: backbone features + RPN outputs + the anchor grid
     (compile-time const). Used by every forward variant."""
-    feat = model.apply(params, images, method=FasterRCNN.extract)
+    feat = model.apply(params, images, masks, method=FasterRCNN.extract)
     rpn_cls_logits, rpn_bbox_deltas = model.apply(
         params, feat, method=FasterRCNN.rpn_forward)
     anchors = jnp.asarray(anchor_grid(
@@ -173,9 +185,12 @@ def _backbone_rpn(model: FasterRCNN, params, images: jnp.ndarray, cfg: Config):
     return feat, rpn_cls_logits, rpn_bbox_deltas, anchors
 
 
-def _assign_anchors_batch(anchors, batch, rng, cfg: Config):
-    """vmapped assign_anchor over the batch (train-mode RPN targets)."""
-    b = batch["image"].shape[0]
+def _assign_anchors_batch(anchors, gt_boxes, gt_valid, im_info, rng,
+                          cfg: Config):
+    """vmapped assign_anchor over per-image rows (train-mode RPN
+    targets). Rows may be bucketed (im_info (B, 3)) or graftcanvas
+    packed ((B, 5) placement rows in canvas coordinates)."""
+    b = gt_boxes.shape[0]
     return jax.vmap(
         partial(
             assign_anchor,
@@ -187,8 +202,7 @@ def _assign_anchors_batch(anchors, batch, rng, cfg: Config):
             clobber_positives=cfg.train.rpn_clobber_positives,
         ),
         in_axes=(None, 0, 0, 0, 0),
-    )(anchors, batch["gt_boxes"], batch["gt_valid"], batch["im_info"],
-      jax.random.split(rng, b))
+    )(anchors, gt_boxes, gt_valid, im_info, jax.random.split(rng, b))
 
 
 def forward_train(
@@ -202,23 +216,54 @@ def forward_train(
 
     batch keys: image (B,H,W,3) float32 (mean-subtracted), im_info (B,3),
     gt_boxes (B,G,4), gt_classes (B,G) int32, gt_valid (B,G) bool.
+
+    graftcanvas: a PACKED batch (ops/canvas.py contract) instead carries
+    canvas planes + (P, I, 5) placement im_info; the backbone runs once
+    over the planes (gap cells re-masked) and placements thread through
+    anchors/targets, proposals and ROI pooling so per-image semantics
+    match the bucketed path (tests/test_canvas.py).
     """
+    from mx_rcnn_tpu.ops.canvas import (is_packed_batch, packed_views,
+                                        placement_masks, plane_take)
+    from mx_rcnn_tpu.ops.proposal import generate_proposals_packed
+
     images = batch["image"]
-    im_info = batch["im_info"]
-    b = images.shape[0]
     a = model.num_anchors
     stride = cfg.network.rpn_feat_stride
+    packed = is_packed_batch(batch)
+    if packed:
+        from mx_rcnn_tpu.data.canvas import packed_strides
+
+        v = packed_views(batch)
+        im_info, plane_of = v["im_info"], v["plane_of"]
+        gt = {k: v[k] for k in ("gt_boxes", "gt_classes", "gt_valid")}
+        b = im_info.shape[0]
+        windows = jnp.stack([im_info[:, 3], im_info[:, 4],
+                             im_info[:, 0], im_info[:, 1]], axis=1)
+        masks = placement_masks(batch["im_info"], images.shape[1:3],
+                                packed_strides(cfg))
+    else:
+        im_info, plane_of, windows, masks = batch["im_info"], None, None, None
+        gt = {k: batch[k] for k in ("gt_boxes", "gt_classes", "gt_valid")}
+        b = images.shape[0]
 
     feat, rpn_cls_logits, rpn_bbox_deltas, anchors = _backbone_rpn(
-        model, params, images, cfg)
+        model, params, images, cfg, masks)
 
     # --- RPN targets (reference: assign_anchor on host in AnchorLoader) ---
     k_anchor, k_sample, k_drop = jax.random.split(rng, 3)
-    rpn_t = _assign_anchors_batch(anchors, batch, k_anchor, cfg)
+    rpn_t = _assign_anchors_batch(anchors, gt["gt_boxes"], gt["gt_valid"],
+                                  im_info, k_anchor, cfg)
 
+    rpn_logits_pairs = _pair_logits(rpn_cls_logits, a)
+    rpn_deltas_rows = rpn_bbox_deltas.reshape(rpn_bbox_deltas.shape[0], -1, 4)
+    if packed:
+        # Per-plane head outputs → per-image rows over the canvas grid.
+        rpn_logits_pairs = plane_take(rpn_logits_pairs, plane_of)
+        rpn_deltas_rows = plane_take(rpn_deltas_rows, plane_of)
     rpn_l = rpn_losses(
-        _pair_logits(rpn_cls_logits, a),
-        rpn_bbox_deltas.reshape(b, -1, 4),
+        rpn_logits_pairs,
+        rpn_deltas_rows,
         rpn_t.labels,
         rpn_t.bbox_targets,
         rpn_t.bbox_weights,
@@ -227,18 +272,33 @@ def forward_train(
 
     # --- Proposals (reference: Proposal op; gradients do not flow) ---
     rpn_prob = _rpn_softmax(jax.lax.stop_gradient(rpn_cls_logits), a)
-    rois, roi_valid, _ = generate_proposals(
-        rpn_prob,
-        jax.lax.stop_gradient(rpn_bbox_deltas),
-        im_info,
-        anchors,
-        pre_nms_top_n=cfg.train.rpn_pre_nms_top_n,
-        post_nms_top_n=cfg.train.rpn_post_nms_top_n,
-        nms_thresh=cfg.train.rpn_nms_thresh,
-        min_size=cfg.train.rpn_min_size,
-        feat_stride=stride,
-        topk_impl=cfg.network.proposal_topk,
-    )
+    if packed:
+        p = rpn_prob.shape[0]
+        fg = rpn_prob[..., a:].reshape(p, -1)
+        rois, roi_valid, _ = generate_proposals_packed(
+            plane_take(fg, plane_of),
+            jax.lax.stop_gradient(rpn_deltas_rows),  # already per-image
+            im_info,
+            anchors,
+            pre_nms_top_n=cfg.train.rpn_pre_nms_top_n,
+            post_nms_top_n=cfg.train.rpn_post_nms_top_n,
+            nms_thresh=cfg.train.rpn_nms_thresh,
+            min_size=cfg.train.rpn_min_size,
+            topk_impl=cfg.network.proposal_topk,
+        )
+    else:
+        rois, roi_valid, _ = generate_proposals(
+            rpn_prob,
+            jax.lax.stop_gradient(rpn_bbox_deltas),
+            im_info,
+            anchors,
+            pre_nms_top_n=cfg.train.rpn_pre_nms_top_n,
+            post_nms_top_n=cfg.train.rpn_post_nms_top_n,
+            nms_thresh=cfg.train.rpn_nms_thresh,
+            min_size=cfg.train.rpn_min_size,
+            feat_stride=stride,
+            topk_impl=cfg.network.proposal_topk,
+        )
 
     # --- ROI sampling (reference: ProposalTarget op — host numpy there) ---
     samples = jax.vmap(
@@ -253,12 +313,13 @@ def forward_train(
             bbox_means=cfg.train.bbox_means,
             bbox_stds=cfg.train.bbox_stds,
         ),
-    )(rois, roi_valid, batch["gt_boxes"], batch["gt_classes"], batch["gt_valid"],
+    )(rois, roi_valid, gt["gt_boxes"], gt["gt_classes"], gt["gt_valid"],
       jax.random.split(k_sample, b))
 
     r = cfg.train.batch_rois
     pooled = _pool_rois(feat, samples.rois, samples.valid,
-                        model.roi_pool_size, model.roi_pool_type)
+                        model.roi_pool_size, model.roi_pool_type,
+                        plane_of=plane_of, windows=windows)
     cls_logits, bbox_deltas = model.apply(
         params, pooled, False, method=FasterRCNN.box_head,
         rngs={"dropout": k_drop})
@@ -284,7 +345,7 @@ def forward_train(
         "rcnn_bbox_loss": rcnn_l["rcnn_bbox_loss"],
         "total_loss": total,
         # Metric auxiliaries (train/metrics.py — the reference's 6 metrics).
-        "rpn_logits": _pair_logits(rpn_cls_logits, a),
+        "rpn_logits": rpn_logits_pairs,  # per-image rows (packed: gathered)
         "rpn_labels": rpn_t.labels,
         "rcnn_logits": cls_logits,
         "rcnn_labels": labels,
@@ -352,12 +413,18 @@ def forward_train_rpn(
     Reference: the rpn-only symbols get_*_rpn + rcnn/tools/train_rpn.py.
     Same batch contract as forward_train; only the RPN pair of losses.
     """
+    if batch["im_info"].ndim == 3:
+        raise ValueError("canvas packing (image.canvas_pack) supports the "
+                         "end2end forward only; the alternate-training "
+                         "stages run bucketed")
     images = batch["image"]
     b = images.shape[0]
     a = model.num_anchors
     feat, rpn_cls_logits, rpn_bbox_deltas, anchors = _backbone_rpn(
         model, params, images, cfg)
-    rpn_t = _assign_anchors_batch(anchors, batch, rng, cfg)
+    rpn_t = _assign_anchors_batch(anchors, batch["gt_boxes"],
+                                  batch["gt_valid"], batch["im_info"],
+                                  rng, cfg)
     rpn_l = rpn_losses(
         _pair_logits(rpn_cls_logits, a),
         rpn_bbox_deltas.reshape(b, -1, 4),
@@ -388,6 +455,10 @@ def forward_train_rcnn(
     (selective-search or stage-RPN proposals). Batch additionally carries
     proposals (B, P, 4) + proposal_valid (B, P).
     """
+    if batch["im_info"].ndim == 3:
+        raise ValueError("canvas packing (image.canvas_pack) supports the "
+                         "end2end forward only; the alternate-training "
+                         "stages run bucketed")
     images = batch["image"]
     b = images.shape[0]
     feat = model.apply(params, images, method=FasterRCNN.extract)

@@ -63,13 +63,26 @@ class FPNNeck(nn.Module):
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, feats: Sequence[jnp.ndarray]) -> Dict[int, jnp.ndarray]:
+    def __call__(self, feats: Sequence[jnp.ndarray],
+                 masks=None) -> Dict[int, jnp.ndarray]:
+        """masks (graftcanvas): {stride: (B, H/s, W/s, 1)} placement
+        masks re-zeroing packed-canvas gap cells after every biased conv
+        (laterals and 3x3 outputs both carry biases, so a gap cell would
+        otherwise turn into a bias halo the next conv — or the RPN head —
+        reads where the bucketed path reads implicit zero padding). The
+        nearest-neighbor upsample maps masked-zero cells onto masked-zero
+        cells (offsets are max-stride aligned), and P6's kernel-1 pool
+        subsamples masked P5, so those need no masks of their own."""
+        m = masks or {}
         c2, c3, c4, c5 = [f.astype(self.dtype) for f in feats]
         laterals = []
         for i, c in enumerate((c2, c3, c4, c5)):
-            laterals.append(
-                nn.Conv(self.channels, (1, 1), dtype=self.dtype,
-                        param_dtype=jnp.float32, name=f"lateral{i + 2}")(c))
+            lat = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
+                          param_dtype=jnp.float32, name=f"lateral{i + 2}")(c)
+            stride = 2 ** (i + 2)
+            if stride in m:
+                lat = lat * m[stride].astype(lat.dtype)
+            laterals.append(lat)
         # Top-down: nearest-neighbor x2 upsample, accumulate.
         merged = [None] * 4
         merged[3] = laterals[3]
@@ -78,10 +91,14 @@ class FPNNeck(nn.Module):
             merged[i] = laterals[i] + up
         out = {}
         for i in range(4):
-            out[i + 2] = nn.Conv(self.channels, (3, 3),
-                                 padding=[(1, 1), (1, 1)], dtype=self.dtype,
-                                 param_dtype=jnp.float32,
-                                 name=f"output{i + 2}")(merged[i])
+            o = nn.Conv(self.channels, (3, 3),
+                        padding=[(1, 1), (1, 1)], dtype=self.dtype,
+                        param_dtype=jnp.float32,
+                        name=f"output{i + 2}")(merged[i])
+            stride = 2 ** (i + 2)
+            if stride in m:
+                o = o * m[stride].astype(o.dtype)
+            out[i + 2] = o
         # P6: stride-2 subsample of P5 (FPN paper: max-pool, kernel 1).
         out[6] = nn.max_pool(out[5], (1, 1), strides=(2, 2))
         return out
@@ -179,8 +196,11 @@ class FPNFasterRCNN(nn.Module):
             self.mask_head = MaskHead(num_classes=self.num_classes,
                                       dtype=self.dtype)
 
-    def extract(self, images: jnp.ndarray) -> Dict[int, jnp.ndarray]:
-        return self.neck(self.features(images))
+    def extract(self, images: jnp.ndarray,
+                masks=None) -> Dict[int, jnp.ndarray]:
+        """masks (graftcanvas): packed-canvas placement masks threaded
+        through the backbone stages and the neck (see FPNNeck)."""
+        return self.neck(self.features(images, masks), masks)
 
     def rpn_forward(self, pyramid: Dict[int, jnp.ndarray]):
         """Shared RPN over P2..P6 → per-level (cls_logits, bbox_deltas)."""
@@ -335,40 +355,98 @@ def fpn_proposals(
     Returns rois (B, post, 4), roi_valid (B, post), roi_scores (B, post).
     """
     tc = cfg.train if train else cfg.test
-    per_level = tc.fpn_rpn_pre_nms_per_level
-    post = tc.rpn_post_nms_top_n
-    a = len(cfg.network.anchor_ratios) * len(cfg.network.anchor_scales)
 
+    def decode(scores, dl, k, anch):
+        return jax.vmap(
+            partial(_decode_one_image, pre_nms_top_n=k,
+                    min_size=tc.rpn_min_size,
+                    topk_impl=cfg.network.proposal_topk),
+            in_axes=(0, 0, 0, None),
+        )(scores, dl, im_info, anch)
+
+    return _select_level_proposals(
+        *_decode_levels(rpn_out, anchors, cfg.network.num_anchors,
+                        tc.fpn_rpn_pre_nms_per_level, lambda x: x, decode),
+        tc.fpn_nms_per_level, tc.rpn_nms_thresh, tc.rpn_post_nms_top_n)
+
+
+def fpn_proposals_packed(
+    rpn_out: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]],
+    anchors: Dict[int, jnp.ndarray],
+    im_info: jnp.ndarray,
+    plane_of: jnp.ndarray,
+    cfg: Config,
+    *,
+    train: bool,
+):
+    """fpn_proposals over a packed canvas (graftcanvas).
+
+    rpn_out holds per-PLANE level maps; im_info (B, 5) packed rows and
+    plane_of (B,) expand them to per-image candidate sets: each image
+    reads its plane's scores/deltas over the canvas grid, keeps only
+    anchors centered in its placement rect, and clips decoded boxes to
+    the rect (ops/proposal.py::_decode_one_window) — so proposals never
+    cross a placement border. Selection semantics (per-level NMS + union
+    top-k, or joint) are fpn_proposals' unchanged.
+    """
+    from mx_rcnn_tpu.ops.canvas import plane_take
+    from mx_rcnn_tpu.ops.proposal import _decode_one_window
+
+    tc = cfg.train if train else cfg.test
+
+    def decode(scores, dl, k, anch):
+        return jax.vmap(
+            partial(_decode_one_window, pre_nms_top_n=k,
+                    min_size=tc.rpn_min_size,
+                    topk_impl=cfg.network.proposal_topk),
+            in_axes=(0, 0, 0, None),
+        )(scores, dl, im_info, anch)
+
+    return _select_level_proposals(
+        *_decode_levels(rpn_out, anchors, cfg.network.num_anchors,
+                        tc.fpn_rpn_pre_nms_per_level,
+                        lambda x: plane_take(x, plane_of), decode),
+        tc.fpn_nms_per_level, tc.rpn_nms_thresh, tc.rpn_post_nms_top_n)
+
+
+def _decode_levels(rpn_out, anchors, num_anchors: int, per_level: int,
+                   row_fn, decode_fn):
+    """Shared per-level head of the (packed and bucketed) FPN proposal
+    paths: fg softmax, row prep (`row_fn`: identity for bucketed rows,
+    plane→image expansion for packed), per-level budgeted decode.
+
+    decode_fn(scores (B, N_l), deltas (B, N_l, 4), k, anchors (N_l, 4))
+    → (boxes, scores, valid) per image; returns the three per-level
+    candidate lists _select_level_proposals consumes."""
     boxes_all: List[jnp.ndarray] = []
     scores_all: List[jnp.ndarray] = []
     valid_all: List[jnp.ndarray] = []
     for lv in RPN_LEVELS:
         cls_logits, deltas = rpn_out[lv]
-        b, h, w, _ = cls_logits.shape
-        prob = _rpn_softmax_fg(cls_logits, a)  # (B, H, W, A) fg prob
-        scores = prob.reshape(b, -1).astype(jnp.float32)
-        dl = deltas.reshape(b, -1, 4).astype(jnp.float32)
+        n = cls_logits.shape[0]
+        prob = _rpn_softmax_fg(cls_logits, num_anchors)
+        scores = row_fn(prob.reshape(n, -1)).astype(jnp.float32)
+        dl = row_fn(deltas.reshape(n, -1, 4)).astype(jnp.float32)
         k = min(per_level, scores.shape[1])
-        tb, ts, tv = jax.vmap(
-            partial(_decode_one_image, pre_nms_top_n=k,
-                    min_size=tc.rpn_min_size,
-                    topk_impl=cfg.network.proposal_topk),
-            in_axes=(0, 0, 0, None),
-        )(scores, dl, im_info, jnp.asarray(anchors[lv]))
+        tb, ts, tv = decode_fn(scores, dl, k, jnp.asarray(anchors[lv]))
         boxes_all.append(tb)
         scores_all.append(ts)
         valid_all.append(tv)
+    return boxes_all, scores_all, valid_all
 
-    if tc.fpn_nms_per_level:
+
+def _select_level_proposals(boxes_all, scores_all, valid_all,
+                            per_level_nms: bool, thresh: float, post: int):
+    """Shared tail of the (packed and bucketed) FPN proposal paths."""
+    if per_level_nms:
         return per_level_nms_union(boxes_all, scores_all, valid_all,
-                                   tc.rpn_nms_thresh, post)
+                                   thresh, post)
 
     boxes = jnp.concatenate(boxes_all, axis=1)
     scores = jnp.concatenate(scores_all, axis=1)
     valid = jnp.concatenate(valid_all, axis=1)
 
-    keep_idx, keep_valid = nms_dispatch(boxes, scores, valid,
-                                        tc.rpn_nms_thresh, post)
+    keep_idx, keep_valid = nms_dispatch(boxes, scores, valid, thresh, post)
     rois = jnp.take_along_axis(boxes, keep_idx[..., None], axis=1)
     kept_scores = jnp.take_along_axis(scores, keep_idx, axis=1)
     roi_scores = jnp.where(keep_valid, kept_scores, 0.0)
@@ -433,20 +511,32 @@ def pyramid_roi_align(
     rois: jnp.ndarray,
     roi_valid: jnp.ndarray,
     pool_size: int,
+    plane_of: jnp.ndarray = None,
+    windows: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """(B, R, 4) rois → (B·R, P, P, C) pooled from each roi's FPN level.
 
     Static-shape strategy: pool from every ROI level and mask-select — the
     matmul ROIAlign is cheap enough that 4x beats any data-dependent
     partition (see module docstring).
+
+    graftcanvas: on a packed batch the pyramid holds PLANES, not images —
+    `plane_of` (B,) maps each image row to its plane, and `windows`
+    (B, 4) [y0, x0, h, w] placement rects clamp border samples to the
+    image's own cells (ops/roi_align.py).
     """
     b, r = rois.shape[0], rois.shape[1]
-    batch_idx = jnp.repeat(jnp.arange(b, dtype=jnp.float32), r)[:, None]
+    ids = (jnp.arange(b, dtype=jnp.float32) if plane_of is None
+           else plane_of.astype(jnp.float32))
+    batch_idx = jnp.repeat(ids, r)[:, None]
     flat = jnp.concatenate([batch_idx, rois.reshape(b * r, 4)], axis=1)
+    win = (None if windows is None
+           else jnp.repeat(windows, r, axis=0))  # (B·R, 4)
     levels = roi_levels(rois.reshape(b * r, 4))
     out = None
     for lv in ROI_LEVELS:
-        pooled = roi_align(pyramid[lv], flat, pool_size, 1.0 / (2 ** lv))
+        pooled = roi_align(pyramid[lv], flat, pool_size, 1.0 / (2 ** lv),
+                           windows=win)
         sel = (levels == lv)[:, None, None, None].astype(pooled.dtype)
         out = pooled * sel if out is None else out + pooled * sel
     return out * roi_valid.reshape(b * r, 1, 1, 1).astype(out.dtype)
@@ -457,8 +547,9 @@ def pyramid_roi_align(
 # ---------------------------------------------------------------------------
 
 
-def _pyramid_rpn(model: FPNFasterRCNN, params, images, cfg: Config):
-    pyramid = model.apply(params, images, method="extract")
+def _pyramid_rpn(model: FPNFasterRCNN, params, images, cfg: Config,
+                 masks=None):
+    pyramid = model.apply(params, images, masks, method="extract")
     rpn_method = ("rpn_forward_packed" if cfg.network.fpn_packed_rpn_head
                   else "rpn_forward")
     rpn_out = model.apply(params, pyramid, method=rpn_method)
@@ -493,13 +584,40 @@ def forward_train(
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """FPN end-to-end train forward. Same batch contract as
     models/faster_rcnn.py::forward_train; adds gt_masks (B, G, M, M) when
-    cfg.network.use_mask (box-frame rasterized instance masks)."""
-    images = batch["image"]
-    im_info = batch["im_info"]
-    b = images.shape[0]
-    a = model.num_anchors
+    cfg.network.use_mask (box-frame rasterized instance masks).
 
-    pyramid, rpn_out, anchors = _pyramid_rpn(model, params, images, cfg)
+    graftcanvas: a PACKED batch (ops/canvas.py contract — planes of
+    shelf-packed images, im_info (P, I, 5) placement rows) runs the
+    backbone/neck once over the canvas planes with gap cells re-masked,
+    then threads placements through anchors/targets, proposals and ROI
+    pooling so per-image semantics match the bucketed path (gated in
+    tests/test_canvas.py)."""
+    from mx_rcnn_tpu.ops.canvas import (is_packed_batch, packed_views,
+                                        placement_masks, plane_take)
+
+    images = batch["image"]
+    a = model.num_anchors
+    packed = is_packed_batch(batch)
+    if packed:
+        from mx_rcnn_tpu.data.canvas import packed_strides
+
+        v = packed_views(batch)
+        im_info, plane_of = v["im_info"], v["plane_of"]
+        gt_boxes, gt_classes = v["gt_boxes"], v["gt_classes"]
+        gt_valid, gt_masks = v["gt_valid"], v.get("gt_masks")
+        b = im_info.shape[0]
+        windows = jnp.stack([im_info[:, 3], im_info[:, 4],
+                             im_info[:, 0], im_info[:, 1]], axis=1)
+        masks = placement_masks(batch["im_info"], images.shape[1:3],
+                                packed_strides(cfg))
+    else:
+        im_info, plane_of, windows, masks = batch["im_info"], None, None, None
+        gt_boxes, gt_classes = batch["gt_boxes"], batch["gt_classes"]
+        gt_valid, gt_masks = batch["gt_valid"], batch.get("gt_masks")
+        b = images.shape[0]
+
+    pyramid, rpn_out, anchors = _pyramid_rpn(model, params, images, cfg,
+                                             masks)
     anchors_cat = jnp.asarray(
         np.concatenate([anchors[lv] for lv in RPN_LEVELS], axis=0))
 
@@ -515,18 +633,27 @@ def forward_train(
             clobber_positives=cfg.train.rpn_clobber_positives,
         ),
         in_axes=(None, 0, 0, 0, 0),
-    )(anchors_cat, batch["gt_boxes"], batch["gt_valid"], batch["im_info"],
+    )(anchors_cat, gt_boxes, gt_valid, im_info,
       jax.random.split(k_anchor, b))
 
     rpn_logits, rpn_deltas = _concat_level_outputs(rpn_out, a)
+    if packed:
+        # Per-plane head outputs → per-image rows: each image reads ITS
+        # plane's canvas grid; its labels ignore every out-of-rect anchor.
+        rpn_logits = plane_take(rpn_logits, plane_of)
+        rpn_deltas = plane_take(rpn_deltas, plane_of)
     rpn_l = rpn_losses(rpn_logits, rpn_deltas, rpn_t.labels,
                        rpn_t.bbox_targets, rpn_t.bbox_weights,
                        cfg.train.rpn_batch_size)
 
     rpn_sg = {lv: (jax.lax.stop_gradient(c), jax.lax.stop_gradient(d))
               for lv, (c, d) in rpn_out.items()}
-    rois, roi_valid, _ = fpn_proposals(rpn_sg, anchors, im_info, cfg,
-                                       train=True)
+    if packed:
+        rois, roi_valid, _ = fpn_proposals_packed(
+            rpn_sg, anchors, im_info, plane_of, cfg, train=True)
+    else:
+        rois, roi_valid, _ = fpn_proposals(rpn_sg, anchors, im_info, cfg,
+                                           train=True)
 
     samples = jax.vmap(
         partial(
@@ -540,12 +667,13 @@ def forward_train(
             bbox_means=cfg.train.bbox_means,
             bbox_stds=cfg.train.bbox_stds,
         ),
-    )(rois, roi_valid, batch["gt_boxes"], batch["gt_classes"],
-      batch["gt_valid"], jax.random.split(k_sample, b))
+    )(rois, roi_valid, gt_boxes, gt_classes,
+      gt_valid, jax.random.split(k_sample, b))
 
     r = cfg.train.batch_rois
     pooled = pyramid_roi_align(pyramid, samples.rois, samples.valid,
-                               model.roi_pool_size)
+                               model.roi_pool_size, plane_of=plane_of,
+                               windows=windows)
     cls_logits, bbox_deltas = model.apply(params, pooled,
                                           method="box_head")
 
@@ -577,14 +705,16 @@ def forward_train(
 
         mask_pooled = pyramid_roi_align(
             pyramid, samples.rois, samples.valid & samples.fg_mask,
-            model.mask_pool_size)
+            model.mask_pool_size, plane_of=plane_of, windows=windows)
         mask_logits = model.apply(params, mask_pooled,
                                   method="mask_forward")
         m_res = mask_logits.shape[1]
+        # gt_masks are BOX-frame, so the canvas shift cancels: rois and
+        # gt boxes are both canvas-coordinate on a packed batch.
         targets = jax.vmap(
             partial(mask_targets_for_rois, resolution=m_res)
-        )(samples.rois, samples.matched_gt, batch["gt_boxes"],
-          batch["gt_masks"])  # (B, R, m, m)
+        )(samples.rois, samples.matched_gt, gt_boxes,
+          gt_masks)  # (B, R, m, m)
         targets = targets.reshape(b * r, m_res, m_res)
         fg = (samples.fg_mask & samples.valid).reshape(-1)
         cls_sel = jnp.maximum(labels, 0)

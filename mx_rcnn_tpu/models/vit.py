@@ -381,12 +381,24 @@ class ViTDet(nn.Module):
             self.mask_head = MaskHead(num_classes=self.num_classes,
                                       dtype=self.dtype)
 
-    def extract(self, images: jnp.ndarray) -> Dict[int, jnp.ndarray]:
+    def extract(self, images: jnp.ndarray,
+                masks=None) -> Dict[int, jnp.ndarray]:
+        """masks (graftcanvas): packed-canvas placement masks applied to
+        the SFP pyramid outputs. The ViT encoder itself attends across
+        the canvas (windowed/global blocks may span placements — a
+        documented approximation, unlike the conv families' exact
+        re-masking); masking the pyramid keeps the RPN/ROI inputs clean
+        so the proposal path stays border-exact."""
         if self.pp_stages:
             feat = self.features(images, self.pipeline_fn)
         else:
             feat = self.features(images, self.global_attn_fn)
-        return self.neck(feat)
+        pyramid = self.neck(feat)
+        if masks:
+            pyramid = {lv: (p * masks[2 ** lv].astype(p.dtype)
+                            if 2 ** lv in masks else p)
+                       for lv, p in pyramid.items()}
+        return pyramid
 
     def rpn_forward(self, pyramid: Dict[int, jnp.ndarray]):
         from mx_rcnn_tpu.models.fpn import RPN_LEVELS

@@ -90,11 +90,14 @@ def mfu_from(flops: Optional[float], steps_per_sec: float,
 def batch_pad_waste(batch) -> Dict[str, Any]:
     """Padding waste of one batch: real pixels ÷ canvas pixels.
 
-    ``im_info`` rows are ``[h, w, scale]`` with (h, w) the post-resize
-    pre-pad content size; the canvas is the image tensor's static
-    (H, W). Works on plain and multi-step-dispatch-stacked batches
-    (leading-axes flattening). Returns {} when the batch lacks the
-    train contract keys (custom loaders)."""
+    ``im_info`` rows are ``[h, w, scale]`` (or graftcanvas packed
+    ``[h, w, scale, y0, x0]``) with (h, w) the content size; the canvas
+    is the image tensor's static (H, W) × its PLANE count — for a
+    bucketed batch that is one canvas per im_info row, for a packed
+    batch one per canvas plane holding several rows, so packed rows
+    honestly report canvas utilization. Works on plain and multi-step-
+    dispatch-stacked batches (leading-axes flattening). Returns {} when
+    the batch lacks the train contract keys (custom loaders)."""
     try:
         image = batch["image"]
         info = np.asarray(batch["im_info"], np.float64)
@@ -104,9 +107,10 @@ def batch_pad_waste(batch) -> Dict[str, Any]:
     if len(shape) < 3 or info.ndim < 1:
         return {}
     canvas_h, canvas_w = int(shape[-3]), int(shape[-2])
+    planes = int(np.prod(shape[:-3], dtype=np.int64)) if len(shape) > 3 else 1
     rows = info.reshape(-1, info.shape[-1])
     real = float(np.sum(rows[:, 0] * rows[:, 1]))
-    canvas = float(len(rows) * canvas_h * canvas_w)
+    canvas = float(planes * canvas_h * canvas_w)
     if canvas <= 0:
         return {}
     return {

@@ -265,8 +265,11 @@ def render(summary: Dict[str, Any]) -> str:
             f"  cost:       mfu {cost.get('mfu')} | "
             f"{len(cost['buckets'])} bucket(s)")
     if summary.get("pad_waste") is not None:
+        # Canvas utilization (real/canvas px) rides next to MFU above:
+        # graftcanvas packed-vs-bucketed runs grade both in one report.
         lines.append(f"  pad waste:  {summary['pad_waste']:.1%} of canvas "
-                     "pixels (p50)")
+                     f"pixels (p50) | canvas util "
+                     f"{1.0 - summary['pad_waste']:.1%}")
     for t in summary.get("traces", ()):
         ph = (t.get("summary") or {}).get("phases")
         lines.append(f"  trace:      [{t.get('reason')}] {t.get('dir')}"

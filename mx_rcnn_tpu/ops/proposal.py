@@ -97,15 +97,63 @@ def generate_proposals(
         in_axes=(0, 0, 0, None),
     )(scores, deltas, im_info, anchors)
 
+    return _nms_select(top_boxes, top_scores, top_valid, nms_thresh,
+                       post_nms_top_n, nms_impl)
+
+
+def generate_proposals_packed(
+    fg_scores: jnp.ndarray,
+    deltas: jnp.ndarray,
+    im_info: jnp.ndarray,
+    anchors: jnp.ndarray,
+    *,
+    pre_nms_top_n: int,
+    post_nms_top_n: int,
+    nms_thresh: float,
+    min_size: float,
+    nms_impl: str = "auto",
+    topk_impl: str = "exact",
+):
+    """generate_proposals over a packed canvas (graftcanvas).
+
+    Args:
+      fg_scores: (B, N) per-IMAGE rows of the image's PLANE's fg scores
+        over the full canvas anchor grid (ops/canvas.py::plane_take).
+      deltas: (B, N, 4) likewise.
+      im_info: (B, 5) packed rows [h, w, scale, y0, x0].
+      anchors: (N, 4) canvas anchor grid (static const).
+
+    Per image, only anchors whose center lies inside the placement rect
+    participate, decoded boxes clip to the RECT (not the canvas), and
+    min-size uses the image's own scale — so no proposal ever crosses a
+    placement border (tests/test_canvas.py border-isolation gate) and
+    each image reproduces the bucketed per-image decode exactly.
+    Returns (rois, roi_valid, roi_scores) in CANVAS coordinates, same
+    shapes/padding as generate_proposals.
+    """
+    k = min(pre_nms_top_n, fg_scores.shape[1])
+    top_boxes, top_scores, top_valid = jax.vmap(
+        partial(_decode_one_window, pre_nms_top_n=k, min_size=min_size,
+                topk_impl=topk_impl),
+        in_axes=(0, 0, 0, None),
+    )(fg_scores.astype(jnp.float32), deltas.astype(jnp.float32), im_info,
+      anchors)
+    return _nms_select(top_boxes, top_scores, top_valid, nms_thresh,
+                       post_nms_top_n, nms_impl)
+
+
+def _nms_select(top_boxes, top_scores, top_valid, nms_thresh: float,
+                post_nms_top_n: int, nms_impl: str):
+    """Shared post-decode tail of the bucketed and packed proposal
+    paths: NMS, gather, score zeroing, pad-with-first-kept-roi."""
     keep_idx, keep_valid = nms_dispatch(
         top_boxes, top_scores, top_valid, nms_thresh, post_nms_top_n,
         impl=nms_impl)
-
     rois = jnp.take_along_axis(top_boxes, keep_idx[..., None], axis=1)
     kept_scores = jnp.take_along_axis(top_scores, keep_idx, axis=1)
     roi_scores = jnp.where(keep_valid, kept_scores, 0.0)
-    # Pad invalid slots with the first (highest-score) kept roi so downstream
-    # pooling reads a real box; validity mask excludes them from sampling.
+    # Pad invalid slots with the first (highest-score) kept roi so
+    # downstream pooling reads a real box; validity masks them out.
     rois = jnp.where(keep_valid[..., None], rois, rois[:, :1, :])
     return rois, keep_valid, roi_scores
 
@@ -124,6 +172,40 @@ def _decode_one_image(scores, deltas, im_info, anchors, *, pre_nms_top_n,
     # top-k pre-NMS trim. "approx" keeps score ORDER within the returned
     # set (approx_max_k returns sorted results; only membership at the
     # tail is approximate), so downstream NMS semantics are unchanged.
+    if topk_impl == "approx":
+        top_scores, top_idx = lax.approx_max_k(
+            scores, pre_nms_top_n, recall_target=0.95)
+    elif topk_impl == "exact":
+        top_scores, top_idx = lax.top_k(scores, pre_nms_top_n)
+    else:
+        raise ValueError(
+            f"topk_impl must be 'exact' or 'approx', got {topk_impl!r}")
+    top_boxes = boxes[top_idx]
+    top_valid = top_scores > -1e9
+    return top_boxes, top_scores, top_valid
+
+
+def _decode_one_window(scores, deltas, info, anchors, *, pre_nms_top_n,
+                       min_size, topk_impl: str = "exact"):
+    """_decode_one_image against a placement WINDOW of a packed canvas.
+
+    info = [h, w, scale, y0, x0]. Same pipeline and ordering as the
+    bucketed decode — decode, clip, min-size, top-k — with two deltas:
+    anchors outside the window (center test) are masked out of the score
+    race, and clipping happens in window-local coordinates (shift, clip
+    to (h, w), shift back — identical arithmetic to the bucketed clip,
+    so a canvas placement reproduces its bucketed image bit-for-bit).
+    """
+    from mx_rcnn_tpu.ops.canvas import anchors_in_window
+
+    boxes = bbox_pred(anchors, deltas)  # (N, 4) canvas coords
+    shift = jnp.stack([info[4], info[3], info[4], info[3]])
+    boxes = clip_boxes(boxes - shift, (info[0], info[1])) + shift
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    min_sz = min_size * info[2]
+    keep = (ws >= min_sz) & (hs >= min_sz) & anchors_in_window(anchors, info)
+    scores = jnp.where(keep, scores, -1e10)
     if topk_impl == "approx":
         top_scores, top_idx = lax.approx_max_k(
             scores, pre_nms_top_n, recall_target=0.95)

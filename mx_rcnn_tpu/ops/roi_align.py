@@ -37,18 +37,23 @@ import jax
 import jax.numpy as jnp
 
 
-def _tent_weights(lo, bin_size, p: int, s: int, extent: int):
+def _tent_weights(lo, bin_size, p: int, s: int, extent: int,
+                  clip_lo=0.0, clip_hi=None):
     """Per-bin averaged bilinear sample weights along one axis.
 
     For bin i, the s sample points sit at ``lo + (i + (k+0.5)/s) * bin_size``;
     each contributes tent-function (hat) weights to its two integer
-    neighbors. Points are clamped to [0, extent-1] (CUDA-kernel border
-    semantics). Returns (P, extent) float32 with the 1/s bin average folded
-    in, so ``W @ feat`` directly yields bin-averaged bilinear samples.
+    neighbors. Points are clamped to [clip_lo, clip_hi] — by default the
+    full feature extent [0, extent-1] (CUDA-kernel border semantics);
+    packed canvases pass the ROI's placement window instead (graftcanvas),
+    so a border sample clamps to the IMAGE's last cell exactly as the
+    bucketed per-image map would, rather than drifting into the zero gap.
+    Returns (P, extent) float32 with the 1/s bin average folded in, so
+    ``W @ feat`` directly yields bin-averaged bilinear samples.
     """
     grid = (jnp.arange(p * s, dtype=jnp.float32) + 0.5) / s  # (p*s,)
     pts = lo + grid * bin_size
-    pts = jnp.clip(pts, 0.0, extent - 1.0)
+    pts = jnp.clip(pts, clip_lo, extent - 1.0 if clip_hi is None else clip_hi)
     idx = jnp.arange(extent, dtype=jnp.float32)
     tent = jnp.maximum(0.0, 1.0 - jnp.abs(pts[:, None] - idx[None, :]))
     return tent.reshape(p, s, extent).mean(axis=1)  # (p, extent)
@@ -61,6 +66,7 @@ def roi_align(
     spatial_scale: float,
     sampling_ratio: int = 2,
     aligned: bool = False,
+    windows: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """ROIAlign.
 
@@ -73,6 +79,10 @@ def roi_align(
       spatial_scale: e.g. 1/16 for C4.
       sampling_ratio: sample points per bin axis.
       aligned: half-pixel correction.
+      windows: optional (R, 4) rows [y0, x0, h, w] in image coords — the
+        ROI's placement rect on a packed canvas (graftcanvas). Sample
+        points then clamp to the rect's feature cells instead of the
+        whole map, reproducing the bucketed per-image border behavior.
 
     Returns: (R, P, P, C), features.dtype.
     """
@@ -81,18 +91,25 @@ def roi_align(
     s = sampling_ratio
     offset = 0.5 if aligned else 0.0
 
-    def one_roi_weights(roi):
+    def one_roi_weights(roi, win):
         x1 = roi[1] * spatial_scale - offset
         y1 = roi[2] * spatial_scale - offset
         x2 = roi[3] * spatial_scale - offset
         y2 = roi[4] * spatial_scale - offset
         rw = jnp.maximum(x2 - x1, 1.0) if not aligned else (x2 - x1)
         rh = jnp.maximum(y2 - y1, 1.0) if not aligned else (y2 - y1)
-        wy = _tent_weights(y1, rh / p, p, s, h)  # (P, H)
-        wx = _tent_weights(x1, rw / p, p, s, w)  # (P, W)
+        cy = cx = (0.0, None)
+        if win is not None:
+            wy0 = win[0] * spatial_scale
+            wx0 = win[1] * spatial_scale
+            cy = (wy0, wy0 + jnp.ceil(win[2] * spatial_scale) - 1.0)
+            cx = (wx0, wx0 + jnp.ceil(win[3] * spatial_scale) - 1.0)
+        wy = _tent_weights(y1, rh / p, p, s, h, *cy)  # (P, H)
+        wx = _tent_weights(x1, rw / p, p, s, w, *cx)  # (P, W)
         return wy, wx
 
-    wy, wx = jax.vmap(one_roi_weights)(rois)  # (R, P, H), (R, P, W)
+    wy, wx = jax.vmap(one_roi_weights, in_axes=(0, None if windows is None
+                                                else 0))(rois, windows)
     batch_idx = rois[:, 0].astype(jnp.int32)
     dt = features.dtype
     wy = wy.astype(dt)

@@ -70,17 +70,25 @@ def assign_anchor(
       anchors: (N, 4) static anchor grid (ops.anchors.anchor_grid).
       gt_boxes: (G, 4) padded gt boxes (x1,y1,x2,y2).
       gt_valid: (G,) bool.
-      im_info: (3,) = (height, width, scale) of the true image extent.
+      im_info: (3,) = (height, width, scale) of the true image extent —
+        or a PACKED (5,) row [h, w, scale, y0, x0] (graftcanvas), where
+        the extent is the image's placement RECT inside the canvas and
+        the anchors/gt boxes arrive in canvas coordinates. The inside
+        test then bounds against the rect, so only the image's own
+        anchors participate; cross-image IoU is structurally zero
+        (placements are disjoint).
       key: PRNG key for the subsampling.
     """
     n = anchors.shape[0]
     k_fg, k_bg = jax.random.split(key)
 
+    y0 = im_info[3] if im_info.shape[0] >= 5 else 0.0
+    x0 = im_info[4] if im_info.shape[0] >= 5 else 0.0
     inside = (
-        (anchors[:, 0] >= -allowed_border)
-        & (anchors[:, 1] >= -allowed_border)
-        & (anchors[:, 2] < im_info[1] + allowed_border)
-        & (anchors[:, 3] < im_info[0] + allowed_border)
+        (anchors[:, 0] >= x0 - allowed_border)
+        & (anchors[:, 1] >= y0 - allowed_border)
+        & (anchors[:, 2] < x0 + im_info[1] + allowed_border)
+        & (anchors[:, 3] < y0 + im_info[0] + allowed_border)
     )
 
     iou = bbox_overlaps(anchors, gt_boxes)  # (N, G)

@@ -124,7 +124,9 @@ def main(argv=None):
 
         def with_targets(p, bt, r):
             _, cl, bx, anch = F._backbone_rpn(model, p, bt["image"], cfg)
-            t = F._assign_anchors_batch(anch, bt, r, cfg)
+            t = F._assign_anchors_batch(anch, bt["gt_boxes"],
+                                        bt["gt_valid"], bt["im_info"],
+                                        r, cfg)
             return jnp.sum(t.labels), jnp.sum(cl.astype(jnp.float32))
         _timeit("+anchor targets", jax.jit(with_targets), params, batch, rng,
                 iters=args.iters, elog=elog)

@@ -204,6 +204,30 @@ def fit_detector(
         from dataclasses import replace as _replace
         loader_cfg = cfg.with_updates(train=_replace(
             cfg.train, batch_images=cfg.train.batch_images * accum))
+        if cfg.image.canvas_pack and not cfg.image.canvas_images:
+            # graftcanvas × grad accum: planes stay one per MICRO-step
+            # (images per plane = the un-accumulated batch) so the
+            # step's accum reshape slices whole planes per chunk.
+            loader_cfg = loader_cfg.with_updates(image=_replace(
+                loader_cfg.image, canvas_images=cfg.train.batch_images))
+        elif (cfg.image.canvas_pack
+              and cfg.train.batch_images % cfg.image.canvas_images):
+            # A user-set canvas_images that doesn't divide the MICRO
+            # batch would pass the loader's validate (which sees the
+            # accumulated batch) and then die as an opaque reshape error
+            # inside the jitted accum split — fail loudly here instead.
+            raise ValueError(
+                f"image.canvas_images={cfg.image.canvas_images} must "
+                f"divide the un-accumulated train.batch_images="
+                f"{cfg.train.batch_images} under grad_accum_steps="
+                f"{accum}: each micro-step must consume whole planes")
+    if cfg.image.canvas_pack:
+        # Fail fast (cfg-contract): surface a mis-sized canvas or an
+        # unsupported family here, before prefetch workers spin up (the
+        # loader validates too, but a worker-thread raise is noisier).
+        from mx_rcnn_tpu.data.canvas import validate_canvas_pack
+
+        validate_canvas_pack(loader_cfg)
 
     if loader_factory is None:
         loader = AnchorLoader(roidb, loader_cfg, num_shards=n_local,

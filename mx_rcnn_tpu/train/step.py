@@ -108,6 +108,13 @@ def make_train_step(
     (end2end / rpn-only / rcnn-only — the reference's get_*_train symbol
     variants).
 
+    graftcanvas (image.canvas_pack): packed batches shard/accumulate
+    UNCHANGED through this machinery — every leaf's leading dim is the
+    plane count P (one-plus planes per data shard; im_info/gt tensors are
+    (P, I, ...)), so the P('data') sharding, the accum inner-reshape and
+    multi-step stacking all slice whole planes. The forward detects the
+    packed contract from the batch itself (ops/canvas.py).
+
     cfg.train.multi_step_dispatch = K > 1 returns a MULTI-step function:
     it takes step-stacked batches (leaves (K, B, ...), sharded
     P(None, 'data')) and performs K full optimizer steps in one

@@ -8,6 +8,7 @@ each bucket costs one extra jit compile of the train step.
 """
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -43,16 +44,33 @@ def _roidb(n=12):
 
 
 def test_pad_shape_for_fallback_rule():
-    """pad_shapes is honored only when it matches scales entry-for-entry —
+    """An EMPTY pad_shapes falls back to pad_shape (the documented
+    override path — generate_config empties the preset buckets);
     overriding scales alone must not pair with stale buckets."""
     cfg = generate_config("resnet50_fpn", "synthetic",
                           **{"image.scales": ((128, 128),),
                              "image.pad_shape": (128, 128)})
-    # preset pad_shapes (2 entries) vs overridden scales (1) → fallback
+    # generate_config drops the preset buckets → empty → fallback
+    assert cfg.image.pad_shapes == ()
     assert pad_shape_for(cfg, 0) == (128, 128)
     cfg2 = generate_config("resnet50_fpn", "synthetic", **TWO_SCALE)
     assert pad_shape_for(cfg2, 0) == (96, 96)
     assert pad_shape_for(cfg2, 1) == (128, 128)
+
+
+def test_pad_shapes_scales_mismatch_is_loud():
+    """The stale-pair trap (cfg-contract): a NON-empty pad_shapes whose
+    length disagrees with scales used to silently fall back to the
+    single pad_shape — scales overridden by hand next to leftover
+    buckets would train under-/over-padded without a word. Now a loud
+    config error; only the empty tuple is the fallback path."""
+    from dataclasses import replace
+
+    cfg = generate_config("resnet50_fpn", "synthetic", **TWO_SCALE)
+    stale = cfg.with_updates(image=replace(
+        cfg.image, scales=cfg.image.scales + ((160, 160),)))
+    with pytest.raises(ValueError, match="entry-for-entry"):
+        pad_shape_for(stale, 0)
 
 
 def test_override_consistency_drops_preset_buckets():

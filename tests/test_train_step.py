@@ -115,8 +115,9 @@ def test_frozen_trunk_with_live_grads_stays_fixed():
     params = init_params(model, cfg, jax.random.PRNGKey(0))
 
     # Sanity: grads through the trunk really are nonzero in this config.
-    grads = jax.grad(lambda p: forward_train(
-        model, p, tiny_batch(1), jax.random.PRNGKey(2), cfg)[0])(params)
+    # (jitted: the eager 128^2 backward costs ~30 s of tier-1 wall time.)
+    grads = jax.jit(jax.grad(lambda p: forward_train(
+        model, p, tiny_batch(1), jax.random.PRNGKey(2), cfg)[0]))(params)
     g = grads["params"]["features"]["stage3"]["block0"]["conv1"]["kernel"]
     assert float(jnp.abs(g).max()) > 0.0
 
@@ -291,8 +292,13 @@ def test_remat_matches_no_remat():
     def loss_fn(model, cfg):
         return lambda p: zoo.forward_train(model, p, batch, key, cfg)[0]
 
-    l_plain, g_plain = jax.value_and_grad(loss_fn(model_plain, cfg_plain))(params)
-    l_remat, g_remat = jax.value_and_grad(loss_fn(model_remat, cfg_remat))(params)
+    # jit both graphs: eager per-op dispatch of the 128^2 fwd+bwd costs
+    # ~45 s of tier-1 wall time; the jitted pair rides the persistent
+    # compile cache (same numerics — the parity being gated).
+    l_plain, g_plain = jax.jit(
+        jax.value_and_grad(loss_fn(model_plain, cfg_plain)))(params)
+    l_remat, g_remat = jax.jit(
+        jax.value_and_grad(loss_fn(model_remat, cfg_remat)))(params)
     assert np.isclose(float(l_plain), float(l_remat), rtol=1e-5)
     flat_p = jax.tree.leaves(g_plain)
     flat_r = jax.tree.leaves(g_remat)
