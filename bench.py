@@ -31,8 +31,19 @@ lands — an rc=124 mid-sweep keeps its finished measurements (the
 BENCH_r05 lesson).
 
 MFU: analytic FLOPs from XLA's own cost model for the whole compiled
-program (fwd+bwd+update, x8 for msd8), divided by the v5e bf16 peak
-(197 TFLOP/s/chip).
+program (fwd+bwd+update, x8 for msd8), divided by the v5e peak OF THE
+RECIPE'S COMPUTE DTYPE (graftcast: 197 TFLOP/s bf16, ~98.5 f32 —
+obs/costs.py::peak_flops_for); every row carries a `compute_dtype`
+field and `ledger check` only grades rows against prior rows of the
+SAME dtype.
+
+The `*_bf16` recipes run graftcast's flatcore-native mixed precision
+(train.compute_dtype=bf16 + train.flat_params: f32 master buffers, ONE
+cast kernel per dtype buffer feeding the forward — train/precision.py);
+`update_r101_bf16` isolates the update+shadow-cast program so the
+cast's marginal cost over the plain flat update (`update_r101`, pinned
+f32 so its trend line keeps measuring the same program) is a tracked
+number.
 
 graftscope: every run also writes an event stream + folded summary to
 MX_RCNN_BENCH_OBS (default ./bench_obs) — per-config `bench` events plus
@@ -186,9 +197,11 @@ def step_flops(compiled) -> float:
 def bench_config(cfg, reps: int = 5, iters: int = 20):
     from mx_rcnn_tpu.models.zoo import build_model, forward_train, init_params
     from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
-    from mx_rcnn_tpu.train import flatcore
+    from mx_rcnn_tpu.train import flatcore, precision
     from mx_rcnn_tpu.train.optimizer import build_optimizer
     from mx_rcnn_tpu.train.step import create_train_state, make_train_step
+
+    policy = precision.policy_of(cfg)
 
     b = cfg.train.batch_images
     multi = max(1, cfg.train.multi_step_dispatch)
@@ -255,12 +268,16 @@ def bench_config(cfg, reps: int = 5, iters: int = 20):
     # cost_analysis() counts the PER-DEVICE (SPMD-partitioned) program, so
     # per-device flops x steps/sec / per-chip peak is already the
     # per-chip MFU — no extra device_count factor (obs_costs.mfu_from).
-    mfu = obs_costs.mfu_from(flops, img_s / b)
+    # The peak is the COMPUTE DTYPE's (graftcast): a bf16 row graded
+    # against the f32 peak would read ~2x inflated.
+    mfu = obs_costs.mfu_from(flops, img_s / b,
+                             obs_costs.peak_flops_for(policy.compute))
     pad = obs_costs.batch_pad_waste(batch)
     return {
         "img_s_per_chip": round(per_chip, 3),
         "step_ms": round(step_ms, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "compute_dtype": policy.short,
         # graftprof: the executable's HBM footprint (args+temps+output
         # −alias from memory_analysis) and this batch's padding waste —
         # the HBM headroom and canvas-packing numbers the ledger tracks.
@@ -279,10 +296,11 @@ def bench_update_config(cfg, reps: int = 5, iters: int = 50):
     No forward/backward: the jitted program is exactly `apply_gradients`,
     donated state, barrier = materializing the step counter's bytes."""
     from mx_rcnn_tpu.models.zoo import build_model, init_params
-    from mx_rcnn_tpu.train import flatcore
+    from mx_rcnn_tpu.train import flatcore, precision
     from mx_rcnn_tpu.train.optimizer import build_optimizer
     from mx_rcnn_tpu.train.step import create_train_state
 
+    policy = precision.policy_of(cfg)
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0))
     key = jax.random.PRNGKey(7)
@@ -320,10 +338,15 @@ def bench_update_config(cfg, reps: int = 5, iters: int = 50):
         flat_ms = timed(flat_state, fgrads)
     return {
         "tree_ms": round(tree_ms, 3),
+        # under compute_dtype=bf16 flat_ms INCLUDES the graftcast shadow
+        # cast (FlatCore.apply re-materializes the bf16 view buffer —
+        # one convert per dtype buffer); vs the f32-pinned update_r101
+        # row this isolates the cast's marginal per-step cost.
         "flat_ms": round(flat_ms, 3),
         "speedup": round(tree_ms / flat_ms, 3) if flat_ms else None,
         "param_leaves": n_leaves,
         "optimizer": cfg.train.optimizer,
+        "compute_dtype": policy.short,
         "compile_s": round(cc.seconds, 3),
         "n_executables": cc.n,
     }
@@ -338,7 +361,9 @@ def bench_eval_config(cfg, batch_size: int = 4, reps: int = 5,
     """
     from mx_rcnn_tpu.models.zoo import build_model, init_params
     from mx_rcnn_tpu.evaluation.tester import Predictor
+    from mx_rcnn_tpu.train import precision
 
+    policy = precision.policy_of(cfg)
     h, w = cfg.image.pad_shape
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0))
@@ -364,12 +389,14 @@ def bench_eval_config(cfg, batch_size: int = 4, reps: int = 5,
     # The detect program is a plain jit on ONE device (no mesh), so the
     # measured rate already IS the per-chip rate — no device_count division
     # (unlike bench_config, whose step shards over all devices).
-    mfu = obs_costs.mfu_from(flops, img_s / batch_size)
+    mfu = obs_costs.mfu_from(flops, img_s / batch_size,
+                             obs_costs.peak_flops_for(policy.compute))
     return {
         "img_s_per_chip": round(img_s, 3),
         "batch_size": batch_size,
         "ms_per_img": round(1000.0 / img_s, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "compute_dtype": policy.short,
         "hbm_bytes": costs.get("hbm_bytes"),
         "compile_s": round(cc.seconds, 3),
         "n_executables": cc.n,
@@ -549,6 +576,23 @@ def main():
             "image.canvas_shape": (1248, 1024)}),
         "fpn_r101_canvas": generate_config("resnet101_fpn", "coco", **{
             "train.batch_images": 2, "image.canvas_pack": True}),
+        # graftcast (train/precision.py): flatcore-native mixed
+        # precision — f32 flat master weights, ONE bf16 cast kernel per
+        # dtype buffer feeding the forward, f32 islands/grads/update.
+        # NOTE on A/B reading: every flat recipe inherits the bf16
+        # DEFAULT, so from round 8 on c4_r101_flat runs this same
+        # one-cast program at b1 — the per-leaf-cast flat baseline
+        # ENDED at round 7, and the one-cast win is read as the flat
+        # recipes' round-7→8 trend (same recipe, same bf16 dtype
+        # bucket). These b2 rows exist to grade the flagship batch
+        # geometry; rows carry compute_dtype so `ledger check` never
+        # grades them against a different dtype.
+        "c4_r101_bf16": generate_config("resnet101", "coco", **{
+            "image.pad_shape": (640, 1024), "train.batch_images": 2,
+            "train.flat_params": True, "train.compute_dtype": "bf16"}),
+        "fpn_r101_bf16": generate_config("resnet101_fpn", "coco", **{
+            "image.pad_shape": (640, 1024), "train.batch_images": 2,
+            "train.flat_params": True, "train.compute_dtype": "bf16"}),
     }
     # Partial-results flush: every completed row lands on disk immediately
     # (rc=124-proof; see flush_partial). The final report supersedes it.
@@ -587,11 +631,20 @@ def main():
     # Isolated optimizer-update microbench (tree vs flat) at full model
     # size: the ~6 ms many-buffer floor, tracked per round in the JSON
     # and PERF.md instead of probe anecdotes.
+    # update_r101/update_detr are PINNED f32 so their trend lines keep
+    # measuring the exact pre-graftcast program (the pure flat update);
+    # update_r101_bf16 adds the shadow cast — the delta vs update_r101
+    # is the cast's marginal per-step cost.
     update_configs = {
         "update_r101": generate_config("resnet101", "coco", **{
-            "image.pad_shape": (640, 1024)}),
+            "image.pad_shape": (640, 1024),
+            "train.compute_dtype": "f32"}),
         "update_detr": generate_config("detr_r50", "coco", **{
-            "image.pad_shape": (640, 1024)}),
+            "image.pad_shape": (640, 1024),
+            "train.compute_dtype": "f32"}),
+        "update_r101_bf16": generate_config("resnet101", "coco", **{
+            "image.pad_shape": (640, 1024),
+            "train.compute_dtype": "bf16"}),
     }
     run_sweep(update_configs, bench_update_config, detail=detail,
               elog=elog, flush_path=flush_path, timeout_s=timeout_s,

@@ -8,6 +8,7 @@ from mx_rcnn_tpu.analysis.rules import (
     cfg_contract,
     chaos_site,
     donation,
+    dtype_cast,
     excepts,
     flat_state,
     host_sync,
@@ -30,6 +31,7 @@ ALL_RULES = (
     flat_state,
     retry,
     chaos_site,
+    dtype_cast,
 )
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,120 @@
+"""dtype-cast-in-jit: hard-coded float dtype casts in model code.
+
+graftcast (train/precision.py) makes the compute dtype a POLICY — one
+knob (``train.compute_dtype``) decides what the forward/backward run in,
+and the sanctioned f32 islands (norm statistics, losses, bbox
+encode/decode, NMS scores) are routed through the central helpers
+(``precision.island`` / ``precision.model_dtype``). A stray
+``x.astype(jnp.float32)`` or ``jnp.asarray(x, jnp.bfloat16)`` in model
+code re-hard-codes one dtype at one call site: under a policy flip it
+either silently re-widens a tensor the policy wanted narrow (perf leak)
+or narrows an island the policy promised stays f32 (numerics leak) —
+and nobody can audit the island set because it is scattered.
+
+Scope: files under ``mx_rcnn_tpu/models/`` only. Model forwards are
+definitionally jit-reachable — train/step.py and evaluation/tester.py
+trace them cross-module, which tracing.py's same-module reachability
+cannot see — so every function in a model module is treated as traced.
+Flagged:
+
+- ``<expr>.astype(<float dtype literal>)``;
+- ``jnp.asarray(x, <float dtype literal>)`` / ``jnp.array(x, ...)`` /
+  ``dtype=``-keyword forms, when ``x`` is NOT itself a literal constant
+  (building a constant in an explicit dtype is construction, not a cast
+  of flowing data).
+
+Not flagged: policy-routed dtypes (``self.dtype``, ``p.dtype``,
+``precision.island``), integer/bool dtypes, ``self.param``/``zeros``
+declarations, and constant construction. Pre-existing casts are adopted
+via ``--write-baseline``, never by weakening the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import dotted_name
+
+NAME = "dtype-cast-in-jit"
+RATIONALE = ("a hard-coded float dtype cast in model code bypasses the "
+             "train.compute_dtype policy — route it through the "
+             "train/precision.py helpers (island/model_dtype)")
+
+#: path prefix of the model code the rule governs
+_SCOPE = "mx_rcnn_tpu/models/"
+
+#: dotted names that are float dtype literals
+_FLOAT_DTYPES = frozenset(
+    f"{mod}.{name}"
+    for mod in ("jnp", "jax.numpy", "np", "numpy")
+    for name in ("float32", "bfloat16", "float16", "float64"))
+#: string spellings of the same
+_FLOAT_STRINGS = frozenset({"float32", "bfloat16", "float16", "float64"})
+
+#: array-coercion callables whose dtype argument the rule inspects
+_COERCERS = frozenset({"jnp.asarray", "jnp.array",
+                       "jax.numpy.asarray", "jax.numpy.array"})
+
+
+def _float_dtype_literal(node: Optional[ast.AST]) -> Optional[str]:
+    """'jnp.float32' (or the quoted spelling) if ``node`` is a
+    hard-coded float dtype literal, else None."""
+    if node is None:
+        return None
+    name = dotted_name(node)
+    if name in _FLOAT_DTYPES:
+        return name
+    if isinstance(node, ast.Constant) and node.value in _FLOAT_STRINGS:
+        return repr(node.value)
+    return None
+
+
+def _is_constant_expr(node: ast.AST) -> bool:
+    """Literal data (numbers, or lists/tuples of literal data): building
+    a constant in an explicit dtype is not a cast of flowing values."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)):
+        return _is_constant_expr(node.operand)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_constant_expr(e) for e in node.elts)
+    return False
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    if not ctx.rel_path.startswith(_SCOPE):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # <expr>.astype(<float literal>) — positional or dtype=keyword
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"):
+            arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "dtype"),
+                None)
+            lit = _float_dtype_literal(arg)
+            if lit:
+                yield ctx.finding(
+                    NAME, node,
+                    f".astype({lit}) hard-codes a float dtype in model "
+                    "code — use the train/precision.py policy helpers "
+                    "(island() for the sanctioned f32 islands, the "
+                    "module's policy dtype otherwise)")
+            continue
+        # jnp.asarray(x, <float literal>) on non-constant x
+        fn = dotted_name(node.func)
+        if fn in _COERCERS:
+            dtype_arg = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "dtype"),
+                None)
+            lit = _float_dtype_literal(dtype_arg)
+            if lit and node.args and not _is_constant_expr(node.args[0]):
+                yield ctx.finding(
+                    NAME, node,
+                    f"{fn}(..., {lit}) casts flowing data to a "
+                    "hard-coded float dtype in model code — route it "
+                    "through the train/precision.py policy helpers")

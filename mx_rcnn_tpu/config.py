@@ -45,8 +45,6 @@ class NetworkConfig:
     # (reference fixed_param_prefix default). Use 0 when training from
     # scratch — freezing random weights is pointless.
     freeze_at: int = 2
-    # bfloat16 compute for conv/matmul path.
-    compute_dtype: str = "bfloat16"
     # Rematerialize ResNet stage activations in the backward (jax.checkpoint
     # via nn.remat) — trades ~1/3 extra FLOPs for HBM, enabling bigger
     # images / per-chip batches (models/backbones.py).
@@ -176,6 +174,19 @@ class TrainConfig:
     # the big batch. The reference has no equivalent (SURVEY.md §3.2).
     # 1 = off.
     grad_accum_steps: int = 1
+    # graftcast (train/precision.py): the mixed-precision policy. "bf16"
+    # (default — the MXU's native dtype, ~2x the f32 peak on v5e) runs
+    # the forward/backward in bfloat16 with f32 master weights, f32
+    # islands (norm statistics, losses, bbox decode/encode, NMS scores)
+    # and f32 gradients/optimizer updates; "f32" runs everything float32
+    # (the numerics reference the bf16 parity gates compare against).
+    # Checkpoints are f32 tree-form either way and interchange between
+    # the two bit-for-bit at the master-weight level. Under
+    # train.flat_params the bf16 param casts collapse to ONE cast kernel
+    # per dtype buffer (the FlatTrainState.compute shadow); tree mode
+    # keeps flax's per-leaf promotion (same values). Accepts the long
+    # spellings "float32"/"bfloat16" too.
+    compute_dtype: str = "bf16"
     # Optimizer slot dtype: "float32" (default) or "bfloat16" — stores
     # the SGD momentum / AdamW first-moment accumulator in bf16 (halves
     # that tree's memory; the AdamW second moment always stays f32 — its

@@ -31,6 +31,7 @@ from mx_rcnn_tpu.models.backbones import ResNetStages
 from mx_rcnn_tpu.ops.boxes import generalized_iou_xyxy
 from mx_rcnn_tpu.ops.matching import auction_assign
 from mx_rcnn_tpu.ops.ring_attention import dense_attention
+from mx_rcnn_tpu.train.precision import island, model_dtype
 
 Dtype = Any
 
@@ -182,8 +183,8 @@ class DETR(nn.Module):
                           name="dec_norm")(hs)
         logits = nn.Dense(self.num_classes, dtype=jnp.float32,
                           param_dtype=jnp.float32, name="class_embed")(
-                              hs.astype(jnp.float32))
-        y = hs.astype(jnp.float32)
+                              island(hs))
+        y = island(hs)
         for i in range(2):
             y = nn.relu(nn.Dense(self.hidden, dtype=jnp.float32,
                                  name=f"bbox_mlp{i}")(y))
@@ -253,7 +254,7 @@ def _one_image_loss(logits, boxes, gt_boxes_n, gt_classes, gt_valid, *,
     cls_loss = jnp.sum(ce * wgt) / jnp.maximum(jnp.sum(wgt), 1e-6)
 
     # Box losses on matched pairs, normalized by gt count.
-    n_gt = jnp.maximum(jnp.sum(gt_valid.astype(jnp.float32)), 1.0)
+    n_gt = jnp.maximum(jnp.sum(island(gt_valid)), 1.0)
     mg = gt_cxcywh[row_to_col]
     l1 = jnp.sum(jnp.abs(boxes - mg), axis=-1) * row_matched
     l1_loss = jnp.sum(l1) / n_gt
@@ -276,7 +277,7 @@ def forward_train(model: DETR, params, batch: Dict[str, jnp.ndarray],
     logits_all, boxes_all = model.apply(params, images, aux_outputs=use_aux)
     if not use_aux:
         logits_all, boxes_all = logits_all[None], boxes_all[None]
-    scale = jnp.asarray([ww, hh, ww, hh], jnp.float32)
+    scale = island(jnp.asarray([ww, hh, ww, hh]))
     gt_n = batch["gt_boxes"] / scale  # normalized xyxy
 
     per_image = lambda lg, bx, g, c, v: _one_image_loss(  # noqa: E731
@@ -303,7 +304,7 @@ def forward_train(model: DETR, params, batch: Dict[str, jnp.ndarray],
         "rcnn_bbox_loss": l1_per_layer[-1] + giou_per_layer[-1],
         "detr_giou_loss": giou_per_layer[-1],
         "total_loss": total,
-        "num_fg": jnp.sum(nmatch[-1]).astype(jnp.float32),
+        "num_fg": island(jnp.sum(nmatch[-1])),
     }
     return total, aux
 
@@ -316,7 +317,7 @@ def forward_test(model: DETR, params, images: jnp.ndarray,
     logits, nboxes = model.apply(params, images)
     q = nboxes.shape[1]
     c = logits.shape[-1]
-    scale = jnp.asarray([ww, hh, ww, hh], jnp.float32)
+    scale = island(jnp.asarray([ww, hh, ww, hh]))
     xyxy = _cxcywh_to_xyxy(nboxes) * scale  # padded-canvas pixels
     scores = jax.nn.softmax(logits, axis=-1)  # (B, Q, C); class 0 = ∅
     boxes_tiled = jnp.tile(xyxy, (1, 1, c))  # (B, Q, 4C)
@@ -335,7 +336,7 @@ def build_detr_model(cfg: Config) -> DETR:
         dec_layers=cfg.network.detr_dec_layers,
         norm=cfg.network.norm,
         freeze_at=cfg.network.freeze_at,
-        dtype=jnp.dtype(cfg.network.compute_dtype),
+        dtype=model_dtype(cfg),
         remat=cfg.network.remat,
     )
 

@@ -36,6 +36,7 @@ from mx_rcnn_tpu.ops.proposal import generate_proposals
 from mx_rcnn_tpu.ops.roi_align import roi_align, roi_pool
 from mx_rcnn_tpu.targets.rcnn_targets import sample_rois
 from mx_rcnn_tpu.targets.rpn_targets import assign_anchor
+from mx_rcnn_tpu.train.precision import island, model_dtype
 
 
 class FasterRCNN(nn.Module):
@@ -101,8 +102,8 @@ class FasterRCNN(nn.Module):
             x = self.head(pooled, deterministic=deterministic)
         else:
             x = self.head(pooled)
-        cls = self.cls_score(x).astype(jnp.float32)
-        box = self.bbox_pred(x).astype(jnp.float32)
+        cls = island(self.cls_score(x))
+        box = island(self.bbox_pred(x))
         return cls, box
 
     def __call__(self, images: jnp.ndarray, rois: jnp.ndarray):
@@ -156,7 +157,7 @@ def _pool_rois(feat, rois, roi_valid, pool_size, pool_type,
     """
     b, r = rois.shape[0], rois.shape[1]
     ids = (jnp.arange(b, dtype=jnp.float32) if plane_of is None
-           else plane_of.astype(jnp.float32))
+           else island(plane_of))
     batch_idx = jnp.repeat(ids, r)[:, None]
     flat = jnp.concatenate([batch_idx, rois.reshape(b * r, 4)], axis=1)
     if pool_type == "align":
@@ -390,9 +391,9 @@ def forward_test(
     # Un-normalize deltas (reference folds means/stds into saved weights at
     # checkpoint time — rcnn/core/callback.py do_checkpoint; we keep weights
     # normalized and decode explicitly, see train/checkpoint.py contract).
-    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
+    stds = jnp.tile(island(jnp.asarray(cfg.train.bbox_stds)),
                     model.num_classes)
-    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
+    means = jnp.tile(island(jnp.asarray(cfg.train.bbox_means)),
                      model.num_classes)
     deltas = bbox_deltas.reshape(b, r, -1) * stds + means
     boxes = jax.vmap(bbox_pred)(rois, deltas)  # (B, R, 4C)
@@ -542,7 +543,7 @@ def build_model(cfg: Config) -> FasterRCNN:
         roi_pool_type=cfg.network.roi_pool_type,
         norm=cfg.network.norm,
         freeze_at=cfg.network.freeze_at,
-        dtype=jnp.dtype(cfg.network.compute_dtype),
+        dtype=model_dtype(cfg),
         remat=cfg.network.remat,
     )
 

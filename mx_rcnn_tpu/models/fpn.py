@@ -45,6 +45,7 @@ from mx_rcnn_tpu.ops.proposal import _decode_one_image
 from mx_rcnn_tpu.ops.roi_align import roi_align
 from mx_rcnn_tpu.targets.rcnn_targets import sample_rois
 from mx_rcnn_tpu.targets.rpn_targets import assign_anchor
+from mx_rcnn_tpu.train.precision import island, model_dtype
 
 Dtype = Any
 
@@ -154,7 +155,7 @@ class MaskHead(nn.Module):
                          param_dtype=jnp.float32,
                          kernel_init=nn.initializers.normal(0.001),
                          name="mask_logits")(x)
-        return logits.astype(jnp.float32)
+        return island(logits)
 
 
 class FPNFasterRCNN(nn.Module):
@@ -222,8 +223,8 @@ class FPNFasterRCNN(nn.Module):
 
     def box_head(self, pooled: jnp.ndarray):
         x = self.head(pooled)
-        cls = self.cls_score(x).astype(jnp.float32)
-        box = self.bbox_pred(x).astype(jnp.float32)
+        cls = island(self.cls_score(x))
+        box = island(self.bbox_pred(x))
         return cls, box
 
     def mask_forward(self, pooled: jnp.ndarray):
@@ -425,8 +426,8 @@ def _decode_levels(rpn_out, anchors, num_anchors: int, per_level: int,
         cls_logits, deltas = rpn_out[lv]
         n = cls_logits.shape[0]
         prob = _rpn_softmax_fg(cls_logits, num_anchors)
-        scores = row_fn(prob.reshape(n, -1)).astype(jnp.float32)
-        dl = row_fn(deltas.reshape(n, -1, 4)).astype(jnp.float32)
+        scores = island(row_fn(prob.reshape(n, -1)))
+        dl = island(row_fn(deltas.reshape(n, -1, 4)))
         k = min(per_level, scores.shape[1])
         tb, ts, tv = decode_fn(scores, dl, k, jnp.asarray(anchors[lv]))
         boxes_all.append(tb)
@@ -527,7 +528,7 @@ def pyramid_roi_align(
     """
     b, r = rois.shape[0], rois.shape[1]
     ids = (jnp.arange(b, dtype=jnp.float32) if plane_of is None
-           else plane_of.astype(jnp.float32))
+           else island(plane_of))
     batch_idx = jnp.repeat(ids, r)[:, None]
     flat = jnp.concatenate([batch_idx, rois.reshape(b * r, 4)], axis=1)
     win = (None if windows is None
@@ -721,9 +722,9 @@ def forward_train(
         per_roi = jnp.take_along_axis(
             mask_logits, cls_sel[:, None, None, None], axis=-1)[..., 0]
         bce = optax_sigmoid_bce(per_roi, targets)
-        denom = jnp.maximum(jnp.sum(fg.astype(jnp.float32)), 1.0)
+        denom = jnp.maximum(jnp.sum(island(fg)), 1.0)
         mask_loss = jnp.sum(
-            jnp.mean(bce, axis=(1, 2)) * fg.astype(jnp.float32)) / denom
+            jnp.mean(bce, axis=(1, 2)) * island(fg)) / denom
         total = total + mask_loss
         aux["mask_loss"] = mask_loss
 
@@ -758,9 +759,9 @@ def forward_test(
     cls_logits, bbox_deltas = model.apply(params, pooled,
                                           method="box_head")
     scores = jax.nn.softmax(cls_logits, axis=-1).reshape(b, r, -1)
-    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
+    stds = jnp.tile(island(jnp.asarray(cfg.train.bbox_stds)),
                     model.num_classes)
-    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
+    means = jnp.tile(island(jnp.asarray(cfg.train.bbox_means)),
                      model.num_classes)
     deltas = bbox_deltas.reshape(b, r, -1) * stds + means
     boxes = jax.vmap(bbox_pred)(rois, deltas)
@@ -829,7 +830,7 @@ def build_fpn_model(cfg: Config) -> FPNFasterRCNN:
         mask_pool_size=cfg.network.mask_pool_size,
         norm=cfg.network.norm,
         freeze_at=cfg.network.freeze_at,
-        dtype=jnp.dtype(cfg.network.compute_dtype),
+        dtype=model_dtype(cfg),
         remat=cfg.network.remat,
     )
 

@@ -23,6 +23,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from mx_rcnn_tpu.train.precision import island
+
 
 def smooth_l1(x: jnp.ndarray, sigma: float) -> jnp.ndarray:
     """Elementwise smooth-L1 with the reference's sigma parameterization.
@@ -45,10 +47,10 @@ def softmax_ce_with_ignore(logits: jnp.ndarray, labels: jnp.ndarray) -> tuple:
     """
     valid = labels >= 0
     safe = jnp.maximum(labels, 0)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    logp = jax.nn.log_softmax(island(logits), axis=-1)
     ce = -jnp.take_along_axis(logp, safe[:, None], axis=-1)[:, 0]
     ce = jnp.where(valid, ce, 0.0)
-    count = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    count = jnp.maximum(jnp.sum(island(valid)), 1.0)
     return jnp.sum(ce) / count, ce, valid
 
 
@@ -71,7 +73,7 @@ def rpn_losses(
     cls_loss, ce, valid = softmax_ce_with_ignore(
         rpn_cls_logits.reshape(-1, 2), labels.reshape(-1)
     )
-    diff = (rpn_bbox_deltas - bbox_targets).astype(jnp.float32)
+    diff = island(rpn_bbox_deltas - bbox_targets)
     l1 = smooth_l1(diff, sigma=3.0) * bbox_weights
     bbox_loss = jnp.sum(l1) / float(rpn_batch_size * b)
     return {
@@ -98,7 +100,7 @@ def rcnn_losses(
       degenerate slot); bbox_targets/weights: (R, 4C).
     """
     cls_loss, ce, valid = softmax_ce_with_ignore(cls_logits, labels)
-    diff = (bbox_pred - bbox_targets).astype(jnp.float32)
+    diff = island(bbox_pred - bbox_targets)
     l1 = smooth_l1(diff, sigma=1.0) * bbox_weights
     bbox_loss = jnp.sum(l1) / float(batch_rois * batch_images)
     return {

@@ -17,6 +17,8 @@ from typing import Any, ClassVar, Tuple
 import jax.numpy as jnp
 from flax import linen as nn
 
+from mx_rcnn_tpu.train.precision import island
+
 
 class RPNHead(nn.Module):
     #: Spatial receptive radius (px on the feature grid) of the head's
@@ -46,4 +48,4 @@ class RPNHead(nn.Module):
                               param_dtype=jnp.float32,
                               kernel_init=nn.initializers.normal(0.01),
                               name="rpn_bbox_pred")(x)
-        return cls_logits.astype(jnp.float32), bbox_deltas.astype(jnp.float32)
+        return island(cls_logits), island(bbox_deltas)

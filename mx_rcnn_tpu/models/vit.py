@@ -33,6 +33,7 @@ from flax import linen as nn
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.models.fpn import MaskHead, RPNHead, TwoFCHead
 from mx_rcnn_tpu.ops.ring_attention import dense_attention
+from mx_rcnn_tpu.train.precision import island, model_dtype
 
 Dtype = Any
 
@@ -414,8 +415,8 @@ class ViTDet(nn.Module):
 
     def box_head(self, pooled: jnp.ndarray):
         x = self.head(pooled)
-        return (self.cls_score(x).astype(jnp.float32),
-                self.bbox_pred(x).astype(jnp.float32))
+        return (island(self.cls_score(x)),
+                island(self.bbox_pred(x)))
 
     def mask_forward(self, pooled: jnp.ndarray):
         return self.mask_head(pooled)
@@ -464,7 +465,7 @@ def build_vitdet_model(cfg: Config, global_attn_fn=None,
         depth=cfg.network.vit_depth,
         heads=cfg.network.vit_heads,
         window=cfg.network.vit_window,
-        dtype=jnp.dtype(cfg.network.compute_dtype),
+        dtype=model_dtype(cfg),
         global_attn_fn=global_attn_fn,
         pp_stages=pp_stages,
         pipeline_fn=pipeline_fn,

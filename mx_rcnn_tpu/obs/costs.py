@@ -32,10 +32,32 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-#: v5e bf16 peak per chip — the denominator every MFU in this repo uses
-#: (bench.py, PERF.md). Keeping it here makes report folding jax-free:
-#: cost events carry the peak they were computed against.
-V5E_PEAK_FLOPS = 197e12
+#: v5e per-chip peak FLOP/s by compute dtype (graftcast,
+#: train.compute_dtype): the MXU's bf16 peak is ~2x its f32 peak, so an
+#: MFU must divide by the peak of the dtype the step actually ran —
+#: grading a bf16 step against the f32 peak would read ~2x inflated,
+#: and an f32 step against the bf16 peak ~2x deflated. Keeping the
+#: table here keeps report folding jax-free: cost events carry the peak
+#: they were computed against.
+PEAK_FLOPS = {
+    "bfloat16": 197e12,
+    "float32": 98.5e12,
+}
+
+#: legacy alias — the bf16 peak, the only dtype the repo ran before
+#: graftcast (every pre-round-8 ledger/bench row is a bf16 row).
+V5E_PEAK_FLOPS = PEAK_FLOPS["bfloat16"]
+
+
+def peak_flops_for(compute_dtype: Optional[str]) -> float:
+    """Per-chip peak for a compute dtype name (canonical or the "f32"/
+    "bf16" short spellings); None/unknown falls back to the bf16 peak —
+    the pre-graftcast convention every historical row used."""
+    if not compute_dtype:
+        return V5E_PEAK_FLOPS
+    name = {"f32": "float32", "bf16": "bfloat16"}.get(
+        str(compute_dtype), str(compute_dtype))
+    return PEAK_FLOPS.get(name, V5E_PEAK_FLOPS)
 
 
 def executable_costs(compiled) -> Dict[str, Any]:
@@ -147,10 +169,17 @@ class CostTracker:
     attribution is telemetry, not a dependency of training."""
 
     def __init__(self, elog, label: str = "train_step",
-                 peak_flops: float = V5E_PEAK_FLOPS):
+                 peak_flops: Optional[float] = None,
+                 compute_dtype: Optional[str] = None):
+        """``compute_dtype`` (graftcast policy, canonical name) selects
+        the dtype-correct peak when ``peak_flops`` is not given and is
+        stamped on every ``cost`` event so report/ledger folding can
+        split rows by dtype."""
         self.elog = elog
         self.label = label
-        self.peak_flops = float(peak_flops)
+        self.compute_dtype = compute_dtype
+        self.peak_flops = float(peak_flops if peak_flops is not None
+                                else peak_flops_for(compute_dtype))
         self._seen: set = set()
         self._disabled = False
 
@@ -187,5 +216,7 @@ class CostTracker:
             self._disabled = True
             return
         shapes = {k: list(getattr(v, "shape", ())) for k, v in batch.items()}
+        extra = ({"compute_dtype": self.compute_dtype}
+                 if self.compute_dtype else {})
         self.elog.emit("cost", label=self.label, shapes=shapes,
-                       peak_flops=self.peak_flops, **costs)
+                       peak_flops=self.peak_flops, **extra, **costs)

@@ -44,10 +44,17 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 _METRIC_FIELDS = (
     "img_s_per_chip", "mfu", "step_ms", "hbm_bytes", "pad_waste",
     "compile_s", "n_executables", "tree_ms", "flat_ms", "speedup",
-    "ms_per_img", "error", "timeout_s",
+    "ms_per_img", "error", "timeout_s", "compute_dtype",
 )
 #: the two regression-gated metrics (higher is better for both)
 _GATED = ("img_s_per_chip", "mfu")
+
+
+def row_dtype(row: Dict[str, Any]) -> str:
+    """A row's compute dtype for comparison purposes. Rows predating
+    graftcast carry no field — they all ran the bf16 default (the only
+    compute dtype the repo had), so missing means "bf16"."""
+    return str(row.get("compute_dtype") or "bf16")
 
 
 def default_path() -> str:
@@ -171,7 +178,8 @@ def render_show(rows: List[Dict[str, Any]],
         by_cfg.setdefault(r.get("config", "?"), []).append(r)
     lines = [f"perf ledger — {len(rows)} row(s), "
              f"{len(by_cfg)} config(s)",
-             f"{'config':22s} {'round':>5} {'img/s/chip':>10} {'mfu':>7} "
+             f"{'config':22s} {'round':>5} {'dtype':>5} "
+             f"{'img/s/chip':>10} {'mfu':>7} "
              f"{'step_ms':>8} {'hbm_GB':>7} {'pad_waste':>9} "
              f"{'compile_s':>9} {'sha':>8}"]
     for cfg in sorted(by_cfg):
@@ -183,6 +191,7 @@ def render_show(rows: List[Dict[str, Any]],
             hbm = r.get("hbm_bytes")
             lines.append(
                 f"{cfg:22s} {_fmt(r.get('round'), 5)} "
+                f"{row_dtype(r):>5} "
                 f"{_fmt(r.get('img_s_per_chip'), 10)} "
                 f"{_fmt(r.get('mfu'), 7, 4)} {_fmt(r.get('step_ms'), 8, 2)} "
                 f"{_fmt(hbm / 1e9 if hbm else None, 7, 2)} "
@@ -194,16 +203,22 @@ def render_show(rows: List[Dict[str, Any]],
 
 
 def best_prior(history: List[Dict[str, Any]], config: str,
-               before_round: Optional[int] = None
+               before_round: Optional[int] = None,
+               dtype: Optional[str] = None
                ) -> Dict[str, Optional[Tuple[float, Dict[str, Any]]]]:
     """Best prior value per gated metric for ``config`` (optionally only
     rounds strictly before ``before_round``). 'Best' is per-metric: the
     throughput best and the MFU best may be different rows (b1 vs b2
-    recipes trade them off)."""
+    recipes trade them off). ``dtype`` restricts to rows of that compute
+    dtype (graftcast): a bf16 row's ~2x throughput must not become the
+    bar an f32 row is graded against, and an f32 row must not hide a
+    bf16 regression — cross-dtype rows are simply not comparable."""
     out: Dict[str, Optional[Tuple[float, Dict[str, Any]]]] = {
         m: None for m in _GATED}
     for r in history:
         if r.get("config") != config or r.get("error"):
+            continue
+        if dtype is not None and row_dtype(r) != dtype:
             continue
         if (before_round is not None and r.get("round") is not None
                 and r["round"] >= before_round):
@@ -220,14 +235,17 @@ def check_rows(history: List[Dict[str, Any]],
                candidates: List[Dict[str, Any]],
                threshold: float = 0.10) -> List[str]:
     """Regression messages for every candidate metric more than
-    ``threshold`` below the best prior row of the same config. Configs
-    with no prior history pass (first measurement IS the baseline)."""
+    ``threshold`` below the best prior row of the same config AND the
+    same compute dtype (graftcast: a bf16 win must not mask an f32
+    regression, and vice versa). Configs with no same-dtype prior
+    history pass (first measurement IS the baseline)."""
     problems = []
     for cand in candidates:
         cfg = cand.get("config")
         if not cfg or cand.get("error"):
             continue
-        prior = best_prior(history, cfg, before_round=cand.get("round"))
+        prior = best_prior(history, cfg, before_round=cand.get("round"),
+                           dtype=row_dtype(cand))
         for metric in _GATED:
             v = cand.get(metric)
             best = prior.get(metric)

@@ -73,6 +73,9 @@ def _fold_costs(cost_events, timed, all_step_ms: List[float],
             agg_time_s += (p50 / 1e3) * len(in_bucket)
         buckets.append({
             "canvas": canvas,
+            # graftcast: the dtype this bucket's peak was chosen for —
+            # MFUs from different compute dtypes must not be compared
+            "compute_dtype": c.get("compute_dtype"),
             "flops": flops,
             "bytes_accessed": c.get("bytes_accessed"),
             "hbm_bytes": c.get("hbm_bytes"),
@@ -137,7 +140,8 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "run": {k: run_meta.get(k) for k in
                 ("config_digest", "network", "dataset", "mesh",
                  "jax_version", "backend", "device_count", "git_sha",
-                 "batch_size", "steps_per_epoch", "prefix", "tool")
+                 "batch_size", "steps_per_epoch", "prefix", "tool",
+                 "compute_dtype")
                 if k in run_meta},
         "events": len(events),
         "steps": len(timed),

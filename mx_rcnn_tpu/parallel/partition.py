@@ -86,6 +86,14 @@ def flat_segment_specs(params, specs):
     the caller keeps the per-leaf tree path for the whole state (mixing
     per-segment layouts inside one buffer would force GSPMD to reshard
     every step — worse than the many-buffer floor it replaces).
+
+    graftcast: the compute shadow (``FlatTrainState.compute``, one
+    buffer per float dtype group under ``train.compute_dtype=bf16``)
+    inherits its MASTER buffer's placement by construction — it is
+    derived state keyed by the same dtype-group names, so the ``P()``
+    verdict here covers it, and the future ZeRO-1 flat shards (ROADMAP)
+    shard master and shadow along the same segment boundaries with the
+    cast running shard-local.
     """
     import jax.numpy as jnp
 

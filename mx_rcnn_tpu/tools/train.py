@@ -157,7 +157,12 @@ def fit_detector(
     the same epoch.
     """
     from mx_rcnn_tpu.parallel.distributed import is_primary, local_data_shards
+    from mx_rcnn_tpu.train import precision
 
+    # graftcast: resolve (and validate, loudly, before any device work)
+    # the run's compute-dtype policy — threaded into run_meta and the
+    # cost tracker so every MFU downstream divides by the right peak.
+    policy = precision.policy_of(cfg)
     end_epoch = end_epoch or cfg.train.end_epoch
     # graftscope sink FIRST (it touches no jax): backend acquisition below
     # wants somewhere to emit backend_retry/backend_up events, so an
@@ -349,7 +354,7 @@ def fit_detector(
             cfg, mesh=mesh, prefix=prefix, batch_size=ipd,
             steps_per_epoch=steps_per_epoch, begin_epoch=begin_epoch,
             end_epoch=end_epoch, grad_accum=accum,
-            multi_step_dispatch=multi))
+            multi_step_dispatch=multi, compute_dtype=policy.short))
         if cfg.obs.track_compiles:
             compile_track.activate(obs_log)
         # graftprof: trace windows (obs.trace_at_step counts dispatches
@@ -360,7 +365,10 @@ def fit_detector(
             trace_at_step=cfg.obs.trace_at_step,
             trace_steps=cfg.obs.trace_steps)
         if cfg.obs.cost_analysis:
-            cost_tracker = CostTracker(obs_log)
+            # dtype-aware peak: a bf16 step graded against the f32 peak
+            # would report ~2x the honest MFU (obs/costs.py).
+            cost_tracker = CostTracker(obs_log,
+                                       compute_dtype=policy.short)
         if cfg.obs.watchdog:
             watchdog = StallWatchdog(
                 obs_log, stall_factor=cfg.obs.stall_factor,

@@ -3,23 +3,36 @@
 Reference layer L8 (SURVEY.md §2): rcnn/core/module.py MutableModule,
 rcnn/core/metric.py (6 metrics), rcnn/core/callback.py (Speedometer,
 do_checkpoint). Here: an optax optimizer with reference hyperparameters, a
-pjit-able train step, host-side metric accumulators, and orbax checkpoints.
+pjit-able train step, host-side metric accumulators, orbax checkpoints,
+and the graftcast dtype policy (precision.py).
+
+Attribute access is lazy (PEP 562): ``train/precision.py`` must be
+importable from model code (models/*.py read the compute-dtype policy),
+and an eager ``from .step import ...`` here would close the cycle
+models → train → step → models at import time.
 """
 
-from mx_rcnn_tpu.train.optimizer import build_optimizer, trainable_mask
-from mx_rcnn_tpu.train.step import TrainState, create_train_state, make_train_step
-from mx_rcnn_tpu.train.flatcore import FlatCore, FlatTrainState
-from mx_rcnn_tpu.train.metrics import MetricBag
-from mx_rcnn_tpu.train.callback import Speedometer
+from __future__ import annotations
 
-__all__ = [
-    "build_optimizer",
-    "trainable_mask",
-    "TrainState",
-    "create_train_state",
-    "make_train_step",
-    "FlatCore",
-    "FlatTrainState",
-    "MetricBag",
-    "Speedometer",
-]
+import importlib
+
+_EXPORTS = {
+    "build_optimizer": "mx_rcnn_tpu.train.optimizer",
+    "trainable_mask": "mx_rcnn_tpu.train.optimizer",
+    "TrainState": "mx_rcnn_tpu.train.step",
+    "create_train_state": "mx_rcnn_tpu.train.step",
+    "make_train_step": "mx_rcnn_tpu.train.step",
+    "FlatCore": "mx_rcnn_tpu.train.flatcore",
+    "FlatTrainState": "mx_rcnn_tpu.train.flatcore",
+    "MetricBag": "mx_rcnn_tpu.train.metrics",
+    "Speedometer": "mx_rcnn_tpu.train.callback",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(module), name)

@@ -27,6 +27,22 @@ many-buffer shape itself:
   executable), preserving the r3 hard-zero fix: frozen elements update by
   exactly 0.0 and bit-retain their values.
 
+graftcast (train/precision.py): under ``train.compute_dtype=bf16`` the
+f32 buffers above are MASTER weights, and the state additionally carries
+a bf16 COMPUTE SHADOW per float buffer (``FlatTrainState.compute``):
+the update writes the masters in f32 (bit-exact vs the f32 policy given
+equal grads) and re-materializes the shadow with ONE ``convert`` per
+dtype buffer — a program output, so XLA cannot fold it away or
+re-duplicate it into consumer fusions (``optimization_barrier`` is
+dropped by the CPU pipeline and has no AD rule on jax 0.4.x). The
+forward's param views slice the shadow — except the f32 islands (norm
+statistics/affine, ``precision.is_island_param``), which stay views of
+the master — and the loss differentiates w.r.t. the (master, shadow)
+pair, so the backward yields one bf16 cotangent per buffer that is cast
+UP once and summed into the f32 master gradient before the DP psum and
+the optimizer update. Same values as flax's per-leaf promotion (cast
+commutes with slicing); the per-leaf cast tree is simply gone.
+
 Mode routing: `train.flat_params` opts in; TP/PP trees keep the per-leaf
 path (parallel/partition.py::flat_segment_specs — a sharded leaf has no
 contiguous image inside a replicated flat buffer).
@@ -51,6 +67,7 @@ from flax import struct
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.train import precision
 from mx_rcnn_tpu.train.optimizer import (
     build_optimizer,
     effective_fixed_patterns,
@@ -131,6 +148,24 @@ class SegmentTable:
                   for s in self.segments]
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
+    def unflatten_mixed(self, master, compute,
+                        use_compute: Tuple[bool, ...]) -> Any:
+        """Two-source view assembly (graftcast): segment ``i`` slices the
+        COMPUTE shadow when ``use_compute[i]`` (conv/dense weights — the
+        bf16 fast path) and the MASTER buffer otherwise (f32 islands:
+        norm statistics/affine, plus any non-float dtype group). Same
+        static slice/reshape views as ``unflatten`` — only the source
+        buffer differs per segment."""
+        if len(use_compute) != len(self.segments):
+            raise ValueError(
+                f"use_compute has {len(use_compute)} flags for "
+                f"{len(self.segments)} segments")
+        leaves = [
+            (compute if uc else master)[s.dtype]
+            [s.offset:s.offset + s.size].reshape(s.shape)
+            for s, uc in zip(self.segments, use_compute)]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
     def mask_buffers(self) -> Dict[str, np.ndarray]:
         """Per-dtype 0/1 trainability scale, materialized host-side once
         (it rides in the state so it is program INPUT, not a params-sized
@@ -174,13 +209,22 @@ class FlatTrainState(struct.PyTreeNode):
     `count` mirrors optax's schedule/Adam step count — it can differ from
     `step` on --begin_epoch restarts whose schedule is offset by
     begin_step instead (see fit_detector's resume logic).
+
+    graftcast: `compute` is the compute-dtype shadow of every FLOAT
+    master buffer ({master-dtype-name: bf16 buffer} — the key stays the
+    GROUP name), refreshed by `apply` with one cast per buffer; `{}`
+    under the f32 policy (no extra leaves, no behavior change). Being
+    state, it is a program OUTPUT — the one reliable way to pin the cast
+    as a single materialized kernel — and donation recycles it like any
+    other buffer.
     """
 
     step: jnp.ndarray
     count: jnp.ndarray
-    flat: Any                       # {dtype: params buffer}
+    flat: Any                       # {dtype: f32 master params buffer}
     slots: Any                      # tuple of {dtype: slot buffer}
     masks: Any                      # {dtype: 0/1 buffer}
+    compute: Any                    # {dtype: compute shadow} | {} (f32)
     core: "FlatCore" = struct.field(pytree_node=False)
 
     def apply_gradients(self, grad_bufs) -> "FlatTrainState":
@@ -217,6 +261,17 @@ class FlatCore:
         mask_tree = trainable_mask(params, effective_fixed_patterns(cfg))
         self.table = SegmentTable(params, mask_tree)
         self._discover_slots(params)
+        # graftcast policy (train/precision.py): which segments read the
+        # compute shadow vs the f32 master. Islands (norm statistics and
+        # affine — precision.is_island_param) and non-float groups stay
+        # master views; everything else takes the one-cast bf16 path.
+        self.policy = precision.policy_of(cfg)
+        self.use_compute: Tuple[bool, ...] = tuple(
+            self.policy.mixed
+            and jnp.issubdtype(jnp.dtype(s.dtype), jnp.floating)
+            and jnp.dtype(s.dtype) != self.policy.compute_jnp
+            and not precision.is_island_param(s.path)
+            for s in self.table.segments)
 
     # -- slot layout -------------------------------------------------------
 
@@ -294,6 +349,41 @@ class FlatCore:
 
     # -- state construction / conversion -----------------------------------
 
+    def compute_shadow(self, flat) -> Dict[str, Any]:
+        """The compute-dtype shadow of the float master buffers — ONE
+        cast per buffer ({} under the f32 policy)."""
+        if not self.policy.mixed:
+            return {}
+        return {d: buf for d, buf in precision.cast_buffers(
+            flat, self.policy.compute_jnp).items()
+            if buf.dtype != jnp.dtype(d)}
+
+    def params_view(self, flat, compute):
+        """The param tree a forward should see for (master, shadow)
+        buffers: compute views for the fast path, master views for the
+        f32 islands (and for everything under the f32 policy)."""
+        if not self.policy.mixed:
+            return self.table.unflatten(flat)
+        return self.table.unflatten_mixed(flat, compute, self.use_compute)
+
+    def master_grads(self, grads) -> Dict[str, Any]:
+        """Backward output → f32 master-gradient buffers.
+
+        Under the bf16 policy the loss is differentiated w.r.t. the
+        (flat, compute) pair, so ``grads`` arrives as that pair: the
+        master cotangent (island leaves, already f32) plus the shadow
+        cotangent (bf16). The shadow grad is cast UP once per buffer —
+        the transpose twin of ``compute_shadow``'s cast — and summed, so
+        everything downstream (DP psum, optimizer update) is float32.
+        Under f32 the buffers pass through untouched."""
+        if not self.policy.mixed:
+            return grads
+        g_master, g_compute = grads
+        out = dict(g_master)
+        for d, g in g_compute.items():
+            out[d] = out[d] + g.astype(jnp.dtype(d))
+        return out
+
     def init_state(self, params) -> FlatTrainState:
         """Fresh flat state (the create_train_state analog)."""
         flat = {d: jnp.asarray(b)
@@ -305,7 +395,8 @@ class FlatCore:
                  for d, b in self.table.mask_buffers().items()}
         return FlatTrainState(
             step=jnp.zeros((), jnp.int32), count=jnp.zeros((), jnp.int32),
-            flat=flat, slots=slots, masks=masks, core=self)
+            flat=flat, slots=slots, masks=masks,
+            compute=self.compute_shadow(flat), core=self)
 
     def flatten_state(self, state) -> FlatTrainState:
         """TrainState (tree mode, fresh or checkpoint-restored) → flat."""
@@ -328,7 +419,8 @@ class FlatCore:
                  for d, b in self.table.mask_buffers().items()}
         return FlatTrainState(
             step=jnp.asarray(state.step, jnp.int32), count=count,
-            flat=flat, slots=tuple(slots), masks=masks, core=self)
+            flat=flat, slots=tuple(slots), masks=masks,
+            compute=self.compute_shadow(flat), core=self)
 
     def tree_state(self, fstate: FlatTrainState):
         """Flat state → (params tree, exact optax opt_state) — the
@@ -363,7 +455,16 @@ class FlatCore:
 
     def apply(self, state: FlatTrainState, grads) -> FlatTrainState:
         """One optimizer step over flat buffers (trace-safe; the jitted
-        step calls this through FlatTrainState.apply_gradients)."""
+        step calls this through FlatTrainState.apply_gradients).
+
+        ``grads``: f32 master-gradient buffers ({dtype: buffer}). Under
+        the bf16 policy the backward yields a (master, shadow) cotangent
+        pair — the CALLER combines it via ``master_grads`` before the
+        DP psum / accumulation (train/step.py::_grads_of), so the update
+        itself always runs on f32 buffers, bit-exact across policies
+        given equal gradients. The compute shadow is re-materialized
+        from the NEW masters at the end — the one cast per dtype buffer,
+        pinned by being a program output."""
         lr = self.sched(state.count)
         # optax's safe_int32_increment, computed ONCE: AdamW's bias
         # correction and the stored schedule count share this value.
@@ -384,7 +485,8 @@ class FlatCore:
                 mu_dtypes=self._full_dtype_map(self.slots[0]))
             new_slots = (new_mu, new_nu)
         return state.replace(step=state.step + 1, count=bump,
-                             flat=new_flat, slots=new_slots)
+                             flat=new_flat, slots=new_slots,
+                             compute=self.compute_shadow(new_flat))
 
     def _full_dtype_map(self, spec: _SlotSpec) -> Dict[str, str]:
         out = {d: d for d in self.table.sizes}  # identity for sloteless dts

@@ -1,0 +1,166 @@
+"""graftcast — the central mixed-precision policy (one knob, one cast).
+
+Before this module the repo's bf16 story was implicit: every flax module
+carried ``dtype=bfloat16`` (per PR 1) and cast ITS OWN float32 param
+leaves down at every use — a per-leaf cast tree re-materialized inside
+each compiled step, with no single place that said which numerics run in
+which dtype. ``train.compute_dtype`` replaces that with an explicit
+policy, and flatcore (train/flatcore.py) makes the policy structural:
+
+- **f32 master weights.** Parameters are stored float32, always — in the
+  flat master buffers (flat mode), the tree leaves (tree mode), and the
+  checkpoint (tree form on disk, bit-for-bit interchangeable between
+  ``f32`` and ``bf16`` runs in both directions).
+- **bf16 compute.** With ``train.compute_dtype=bf16`` the forward and
+  backward run bfloat16: activations and the conv/matmul weights are
+  bf16, and matmuls/convs accumulate f32 via XLA's MXU default plus the
+  explicit ``preferred_element_type`` sites (ops/ring_attention.py,
+  ops/roi_align.py, ops/nms_pallas.py).
+- **One cast per dtype buffer (flat mode).** FlatCore carries a COMPUTE
+  SHADOW of each float master buffer in the train state
+  (``FlatTrainState.compute``): the update writes the f32 masters and
+  re-materializes the shadow with ONE ``convert`` per dtype buffer — a
+  program output, so XLA cannot re-duplicate it into consumer fusions
+  (``optimization_barrier`` is dropped by the CPU pipeline and has no AD
+  rule on jax 0.4.x; an output is the only reliable pin). The param tree
+  the forward sees is slice/reshape views of the shadow; the per-leaf
+  cast tree is gone (gated in tests/test_precision.py).
+- **f32 islands.** The numerics that f16-family dtypes demonstrably
+  break stay float32 regardless of the knob: all norm statistics
+  (``is_island_param`` keeps the frozen-BN/GroupNorm/LayerNorm
+  parameters on f32 master views; flax's norm layers already compute
+  their statistics in f32), the losses, ``bbox_transform``
+  encode/decode, and NMS scores — model code routes those casts through
+  :func:`island` (the ``dtype-cast-in-jit`` lint rule points here).
+- **f32 gradients.** The backward's buffer cotangent is cast UP once per
+  buffer (the transpose twin of the shadow cast), so the DP psum and the
+  optimizer update run float32 — the update is bit-exact against the
+  ``f32`` path given identical gradients (tests/test_precision.py).
+
+Tree (per-leaf) mode under ``bf16`` keeps flax's per-leaf promotion —
+same values (cast commutes with slicing), just without the structural
+one-cast win; TP/PP runs therefore lose nothing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+#: accepted ``train.compute_dtype`` spellings → canonical numpy-dtype name
+_CANON = {
+    "f32": "float32",
+    "float32": "float32",
+    "bf16": "bfloat16",
+    "bfloat16": "bfloat16",
+}
+
+#: canonical short spelling (config docs, bench/ledger rows)
+SHORT = {"float32": "f32", "bfloat16": "bf16"}
+
+#: leaf names that ARE norm statistics / affine (FrozenBatchNorm) — plus
+#: ``pos_embed`` (models/vit.py): it is bilinearly RESIZED before its
+#: per-use cast, and cast does not commute with resize, so a bf16 shadow
+#: view would diverge from tree mode's resize-f32-then-cast
+_ISLAND_LEAVES = frozenset(
+    {"gamma", "beta", "moving_mean", "moving_var", "pos_embed"})
+#: module-name fragments of the repo's norm layers: make_norm's ``bn*`` /
+#: ``downsample_bn`` (FrozenBN + GroupNorm) and the transformer
+#: ``norm*`` / ``dec_norm`` LayerNorms (models/vit.py, models/detr.py) —
+#: plus DETR's set-prediction heads (``class_embed`` / ``bbox_mlp*`` /
+#: ``bbox_out``), which are declared ``dtype=jnp.float32`` Denses over
+#: ``island(hs)``: flax computes them with UNCAST f32 weights in tree
+#: mode (no per-use cast for the shadow to commute with), so a bf16
+#: shadow view would silently quantize exactly the box/score numerics
+#: the island contract promises stay f32.
+#: ``_ln`` covers the SFP upsampling LayerNorm (models/vit.py up4_ln)
+_ISLAND_MODULES = ("bn", "norm", "_ln", "class_embed", "bbox_mlp",
+                   "bbox_out")
+
+
+def normalize_compute_dtype(value: str) -> str:
+    """Knob spelling → canonical dtype name; raises on anything else."""
+    key = str(value).strip().lower()
+    if key not in _CANON:
+        raise ValueError(
+            f"train.compute_dtype must be one of "
+            f"{sorted(set(_CANON))}, got {value!r}")
+    return _CANON[key]
+
+
+@dataclass(frozen=True)
+class Policy:
+    """Resolved dtype policy: ``compute`` is what the forward/backward
+    run in, ``master`` what parameters/gradients/optimizer state are
+    stored and updated in (always float32 here — bf16 master weights are
+    a different, accuracy-risky regime this repo does not offer)."""
+
+    compute: str  # canonical dtype name ("float32" | "bfloat16")
+    master: str = "float32"
+
+    @property
+    def mixed(self) -> bool:
+        return self.compute != self.master
+
+    @property
+    def compute_jnp(self):
+        return jnp.dtype(self.compute)
+
+    @property
+    def short(self) -> str:
+        """Ledger/bench row spelling ("f32" / "bf16")."""
+        return SHORT[self.compute]
+
+
+def policy_of(cfg) -> Policy:
+    """The run's policy from ``cfg.train.compute_dtype`` (validated)."""
+    return Policy(compute=normalize_compute_dtype(cfg.train.compute_dtype))
+
+
+def model_dtype(cfg):
+    """The flax-module ``dtype`` the policy implies — every build_model
+    variant reads the knob through here (models/*.py)."""
+    return policy_of(cfg).compute_jnp
+
+
+def island(x: jnp.ndarray) -> jnp.ndarray:
+    """THE sanctioned f32 island cast for model code: losses, norm
+    statistics, bbox_transform encode/decode, NMS scores. Routing the
+    cast through here (instead of a scattered ``.astype(jnp.float32)``)
+    keeps the island set auditable — the ``dtype-cast-in-jit`` lint rule
+    flags hard-coded float dtype literals in model code."""
+    return x.astype(jnp.float32)
+
+
+def is_island_param(path: str) -> bool:
+    """True for param leaves that must stay f32 VIEWS of the master
+    buffer under a bf16 policy: norm statistics and norm affine terms.
+
+    ``path`` is the flatcore segment path ("/"-joined tree keys, e.g.
+    ``params/features/stage2/block0/bn1/scale``). Everything else (conv/
+    dense kernels and biases) reads the compute shadow."""
+    parts = path.split("/")
+    if parts and parts[-1] in _ISLAND_LEAVES:
+        return True
+    # the owning module: norm layers are named bn*/downsample_bn (ResNet/
+    # VGG families) and norm*/dec_norm (ViT/DETR LayerNorms)
+    if len(parts) >= 2:
+        module = parts[-2]
+        if any(frag in module for frag in _ISLAND_MODULES):
+            return True
+    return False
+
+
+def cast_buffers(bufs, dtype):
+    """{name: buffer} → same dict with every FLOAT buffer cast to
+    ``dtype`` — exactly one ``convert`` per float buffer (the flatcore
+    compute-shadow materialization). Non-float buffers pass through."""
+    dtype = jnp.dtype(dtype)
+    out = {}
+    for name, buf in bufs.items():
+        if jnp.issubdtype(buf.dtype, jnp.floating) and buf.dtype != dtype:
+            out[name] = buf.astype(dtype)
+        else:
+            out[name] = buf
+    return out

@@ -132,12 +132,25 @@ def make_train_step(
     backward writes one flat gradient per dtype and the DP allreduce is
     one psum per buffer. Donation, grad accumulation and multi-step
     dispatch compose unchanged (the flat state is an ordinary pytree).
+
+    graftcast (train.compute_dtype=bf16 + flat_core): the differentiated
+    value is the (master, compute-shadow) buffer PAIR — the forward's
+    views slice the bf16 shadow (f32 islands slice the master), the
+    shadow cotangent is cast up once per buffer inside
+    FlatCore.master_grads, and the update re-materializes the shadow
+    from the new masters (one cast per buffer, a program output). Tree
+    mode under bf16 keeps flax's per-leaf promotion — same values.
     """
 
     accum = max(1, int(getattr(cfg.train, "grad_accum_steps", 1)))
     multi = max(1, int(getattr(cfg.train, "multi_step_dispatch", 1)))
-    as_params = (flat_core.table.unflatten if flat_core is not None
-                 else (lambda p: p))
+    if flat_core is not None:
+        def as_params(diff):
+            return flat_core.params_view(*diff) if flat_core.policy.mixed \
+                else flat_core.table.unflatten(diff)
+    else:
+        def as_params(diff):
+            return diff
 
     def _grads_of(diff, chunk, key):
         def loss_fn(p):
@@ -145,10 +158,22 @@ def make_train_step(
             return loss, aux
 
         (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(diff)
+        if flat_core is not None and flat_core.policy.mixed:
+            # Cast the shadow cotangent up and fold it into the f32
+            # master gradient HERE, per micro-step: accumulation, the DP
+            # psum and the update all run float32 from this point on.
+            grads = flat_core.master_grads(grads)
         return grads, _metric_parts(aux)
 
     def _diff_of(state):
-        return state.flat if flat_core is not None else state.params
+        if flat_core is None:
+            return state.params
+        if flat_core.policy.mixed:
+            # graftcast: differentiate the (master, shadow) pair — island
+            # grads land f32 in the master cotangent, the bf16 shadow
+            # cotangent is cast up once per buffer (FlatCore.master_grads)
+            return (state.flat, state.compute)
+        return state.flat
 
     def _one_update(state: TrainState, batch, rng):
         if accum == 1:

@@ -52,12 +52,17 @@ def error_runner(label):
 # the tiny fit (in-process helper + --fit subprocess mode)
 # ---------------------------------------------------------------------------
 
-def tiny_config(flat: bool = False, obs_dir: str = ""):
+def tiny_config(flat: bool = False, obs_dir: str = "", compute: str = "f32"):
     """The 64^2 f32 micro-config of tests/test_flatcore.py, plus
     power-of-two bbox stds: the kill->resume parity gates assert BIT
     exactness, and an emergency save round-trips bbox_pred through
     unnormalize (kernel*std) + renormalize (kernel/std) — exact for
-    powers of two, not for the default 0.1/0.2."""
+    powers of two, not for the default 0.1/0.2. ``compute`` selects the
+    graftcast policy (train/precision.py) — the bf16 parity gates run
+    the exact same resume/heal machinery under compute_dtype=bf16
+    (determinism holds: bf16 rounding is deterministic on one
+    backend, so killed+resumed still matches uninterrupted bit for
+    bit)."""
     from dataclasses import replace
 
     from mx_rcnn_tpu.config import generate_config
@@ -82,14 +87,13 @@ def tiny_config(flat: bool = False, obs_dir: str = ""):
         over["obs.cost_analysis"] = False
     cfg = generate_config("resnet50", "synthetic", **over)
     return cfg.with_updates(
-        network=replace(cfg.network, compute_dtype="float32"),
-        train=replace(cfg.train, flat_params=flat,
+        train=replace(cfg.train, flat_params=flat, compute_dtype=compute,
                       bbox_stds=(0.5, 0.5, 0.25, 0.25)))
 
 
 def run_fit(prefix: str, end_epoch: int = 2, resume=False,
             flat: bool = False, obs_dir: str = "", mesh: str = "1",
-            num_images: int = 3, epoch_metrics=None):
+            num_images: int = 3, epoch_metrics=None, compute: str = "f32"):
     """num_images x 64^2, seed 0 — returns the final host params.
     Deterministic end to end, so an interrupted+resumed (or graftheal-ed)
     run must match an uninterrupted one bit for bit. ``mesh`` sizes the
@@ -105,7 +109,7 @@ def run_fit(prefix: str, end_epoch: int = 2, resume=False,
     if epoch_metrics is not None:
         def cb(epoch, state, bag):
             epoch_metrics.append((epoch, bag.get()))
-    return fit_detector(tiny_config(flat, obs_dir), ds.gt_roidb(),
+    return fit_detector(tiny_config(flat, obs_dir, compute), ds.gt_roidb(),
                         prefix=prefix, end_epoch=end_epoch, frequent=1000,
                         seed=0, mesh_spec=mesh, resume=resume,
                         epoch_callback=cb)
@@ -136,6 +140,8 @@ def main(argv=None):
     p.add_argument("--obs-dir", default="")
     p.add_argument("--mesh", default="1", help="mesh spec (data[xmodel])")
     p.add_argument("--num-images", type=int, default=3)
+    p.add_argument("--compute", default="f32", choices=["f32", "bf16"],
+                   help="graftcast train.compute_dtype policy")
     p.add_argument("--crash-save", metavar="PREFIX",
                    help="one sync checkpoint save (the crash-window probe)")
     p.add_argument("--scale", type=float, default=1.0,
@@ -163,7 +169,7 @@ def main(argv=None):
     if args.fit:
         run_fit(args.fit, end_epoch=args.end_epoch, resume=args.resume,
                 flat=args.flat, obs_dir=args.obs_dir, mesh=args.mesh,
-                num_images=args.num_images)
+                num_images=args.num_images, compute=args.compute)
         return 0
     p.error("one of --fit / --crash-save is required")
 

@@ -40,3 +40,25 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+@pytest.fixture(scope="session")
+def bf16_flat_baseline(tmp_path_factory):
+    """Uninterrupted flat + compute_dtype=bf16 tiny fit params — the ONE
+    graftcast parity reference shared by the kill→resume gate
+    (tests/test_resilience.py) and the heal-carry gate
+    (tests/test_heal.py). Session scope: both files compare against the
+    bit-identical deterministic run, so a single baseline fit pays for
+    both (tier-1 budget). Armed chaos must not leak into it."""
+    import _resilience_driver as driver
+    from mx_rcnn_tpu.resilience import chaos
+
+    old = os.environ.pop(chaos.ENV_VAR, None)
+    chaos.reset()
+    try:
+        prefix = str(tmp_path_factory.mktemp("bf16_base") / "u_bf16")
+        return driver.run_fit(prefix, flat=True, compute="bf16")
+    finally:
+        if old is not None:
+            os.environ[chaos.ENV_VAR] = old
+        chaos.reset()

@@ -984,3 +984,81 @@ def test_time_in_jit_near_miss_unrelated_names():
             return 7
     """)
     assert "time-in-jit" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# dtype-cast-in-jit
+# ---------------------------------------------------------------------------
+
+def lint_model(src):
+    """Lint a snippet AS model code (the rule is scoped to
+    mx_rcnn_tpu/models/ — model forwards are jit-reachable cross-module,
+    which same-module tracing cannot see)."""
+    import textwrap as _tw
+
+    return lint_source(_tw.dedent(src), "mx_rcnn_tpu/models/snippet.py",
+                       Settings(), ALL_RULES)
+
+
+def test_dtype_cast_flags_astype_float_literal_in_model_code():
+    findings = lint_model("""
+        import jax.numpy as jnp
+
+        def forward(params, x):
+            logits = x @ params["w"]
+            return logits.astype(jnp.float32)
+    """)
+    assert sum(f.rule == "dtype-cast-in-jit" for f in findings) == 1
+
+
+def test_dtype_cast_flags_asarray_of_flowing_data_and_string_spelling():
+    findings = lint_model("""
+        import jax.numpy as jnp
+
+        def decode(deltas, stds):
+            d = jnp.asarray(deltas, jnp.bfloat16)      # flowing data
+            s = stds.astype("float32")                 # string spelling
+            return d * s
+    """)
+    assert sum(f.rule == "dtype-cast-in-jit" for f in findings) == 2
+
+
+def test_dtype_cast_flags_keyword_astype_spelling():
+    """x.astype(dtype=jnp.float32) is the same policy bypass as the
+    positional spelling — the rule must not be evadable by keyword."""
+    findings = lint_model("""
+        import jax.numpy as jnp
+
+        def forward(params, x):
+            return x.astype(dtype=jnp.float32)
+    """)
+    assert sum(f.rule == "dtype-cast-in-jit" for f in findings) == 1
+
+
+def test_dtype_cast_near_miss_policy_dtype_int_and_constants():
+    """The sanctioned spellings: the module's policy dtype, integer
+    dtypes, and CONSTANT construction in an explicit dtype."""
+    findings = lint_model("""
+        import jax.numpy as jnp
+
+        class Head:
+            def __call__(self, x):
+                y = x.astype(self.dtype)               # policy-routed
+                idx = y.astype(jnp.int32)              # not a float cast
+                rois = jnp.asarray([[0.0, 0.0, 31.0, 31.0]], jnp.float32)
+                zeros = jnp.zeros((4, 4), jnp.float32)  # declaration
+                return y, idx, rois, zeros
+    """)
+    assert "dtype-cast-in-jit" not in rules_of(findings)
+
+
+def test_dtype_cast_out_of_scope_outside_model_code():
+    """The same cast OUTSIDE mx_rcnn_tpu/models/ is out of scope — host
+    tooling and tests cast freely."""
+    findings = lint("""
+        import jax.numpy as jnp
+
+        def fold(x):
+            return x.astype(jnp.float32)
+    """)
+    assert "dtype-cast-in-jit" not in rules_of(findings)

@@ -33,7 +33,7 @@ def test_bench_update_config_produces_numbers():
         "train.batch_images": 1, "network.anchor_scales": (2, 4),
         "image.pad_shape": (64, 64)})
     cfg = cfg.with_updates(
-        network=replace(cfg.network, compute_dtype="float32"))
+        train=replace(cfg.train, compute_dtype="f32"))
     out = bench.bench_update_config(cfg, reps=1, iters=2)
     assert out["tree_ms"] > 0 and out["flat_ms"] > 0
     assert out["param_leaves"] > 100
@@ -60,10 +60,13 @@ def test_bench_config_rows_carry_cost_fields():
         "network.anchor_scales": (2, 4),
         "image.pad_shape": (64, 64)})
     cfg = cfg.with_updates(
-        network=replace(cfg.network, compute_dtype="float32"))
+        train=replace(cfg.train, compute_dtype="f32"))
     row = bench.bench_config(cfg, reps=1, iters=2)
     assert row["img_s_per_chip"] > 0
     assert row["mfu"] is not None and row["mfu"] >= 0
+    # graftcast: every row names its compute dtype (this cfg pins f32),
+    # the ledger's cross-dtype comparison guard
+    assert row["compute_dtype"] == "f32"
     assert row["hbm_bytes"] > 0
     # make_batch's content size is canvas-proportional (600/640 x
     # 1000/1024), so the padding fraction is a fixed known quantity

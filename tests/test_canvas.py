@@ -313,7 +313,7 @@ def _pair_batches(hw, align):
 @pytest.fixture(scope="module")
 def c4_cfg():
     return _canvas_cfg(**{
-        "network.compute_dtype": "float32",
+        "train.compute_dtype": "f32",
         "network.anchor_scales": (2, 4),
         "train.rpn_batch_size": 1024,  # keep-all: neutralizes the anchor
         # subsample's grid-size-dependent uniform draws (canvas grid !=
@@ -356,7 +356,7 @@ def fpn_cfg():
         "image.canvas_pack": True,
         "image.canvas_shape": (256, 128),
         "image.canvas_images": 2,
-        "network.compute_dtype": "float32",
+        "train.compute_dtype": "f32",
         "network.anchor_scales": (2,),
         "network.proposal_topk": "exact",  # approx_max_k membership is
         # grid-size-dependent; exactness needs the deterministic top-k
@@ -404,7 +404,7 @@ def test_multiscale_canvas_single_compiled_shape(c4_cfg, c4_model_params):
         "image.scales": ((48, 96), (64, 96)),
         "image.pad_shapes": (),
         "image.canvas_shape": (160, 96),
-        "network.compute_dtype": "float32",
+        "train.compute_dtype": "f32",
         "network.anchor_scales": (2, 4),
         "train.rpn_pre_nms_top_n": 64,
         "train.rpn_post_nms_top_n": 16,

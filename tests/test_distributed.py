@@ -158,7 +158,7 @@ cfg = generate_config("detr_r50", "synthetic", **{
     "network.detr_dec_layers": 1,
     "network.norm": "group",
     "network.freeze_at": 0,
-    "network.compute_dtype": "float32",
+    "train.compute_dtype": "f32",
     "network.tensor_parallel": True,
     "train.max_gt_boxes": 4,
     "train.batch_images": 1,
@@ -234,7 +234,7 @@ cfg = generate_config("vitdet_b", "synthetic", **{
     "network.vit_depth": 2,
     "network.vit_heads": 2,
     "network.vit_window": 4,
-    "network.compute_dtype": "float32",
+    "train.compute_dtype": "f32",
     "network.pp_stages": 2,
     "network.anchor_scales": (2, 4),
     "train.fpn_rpn_pre_nms_per_level": 64,

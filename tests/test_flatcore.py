@@ -42,8 +42,7 @@ def _cfg(**train_over):
             "image.pad_shape": (64, 64),
         })
     return cfg.with_updates(
-        network=replace(cfg.network, compute_dtype="float32"),
-        train=replace(cfg.train, **train_over))
+        train=replace(cfg.train, **{"compute_dtype": "f32", **train_over}))
 
 
 def _batch(b):

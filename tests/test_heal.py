@@ -513,7 +513,8 @@ def mesh8_baseline(tmp_path_factory):
             os.environ[chaos.ENV_VAR] = old
 
 
-def _heal_run(tmp_path, monkeypatch, flat, spec, expect_heals):
+def _heal_run(tmp_path, monkeypatch, flat, spec, expect_heals,
+              compute="f32"):
     """Run fit under the armed chaos spec: it must complete WITHOUT
     operator intervention (no exception, no restart, no crash event),
     emitting one `heal` event per injected loss. Returns (params, heals)."""
@@ -521,7 +522,7 @@ def _heal_run(tmp_path, monkeypatch, flat, spec, expect_heals):
     chaos.reset()
     obs_dir = str(tmp_path / "obs_healed")
     params_h = driver.run_fit(str(tmp_path / "healed"), flat=flat,
-                              obs_dir=obs_dir)
+                              obs_dir=obs_dir, compute=compute)
     events = report.load_events(obs_dir)
     heals = [e for e in events if e["type"] == "heal"]
     assert len(heals) == expect_heals, heals
@@ -557,6 +558,22 @@ def test_heal_device_loss_parity_flat(tmp_path, monkeypatch, tree_baseline):
     params_h, _ = _heal_run(tmp_path, monkeypatch, flat=True,
                             spec="device_lost_at_step=4", expect_heals=1)
     _assert_trees_bitexact(tree_baseline, params_h)
+
+
+@pytest.mark.compile_heavy
+def test_heal_carry_preserves_bf16_policy(tmp_path, monkeypatch,
+                                          bf16_flat_baseline):
+    """graftcast across a heal: the carry is f32 tree-form (masters via
+    FlatCore.tree_state — the compute shadow is derived state and is NOT
+    carried), and the rebuilt session re-derives the SAME bf16 policy
+    from cfg — so a healed compute_dtype=bf16 run is bit-exact vs an
+    uninterrupted bf16 run (the session-scope baseline shared with
+    test_resilience's kill→resume gate; the module-scope f32 tree
+    baseline differs by construction)."""
+    params_h, _ = _heal_run(tmp_path, monkeypatch, flat=True,
+                            spec="device_lost_at_step=4", expect_heals=1,
+                            compute="bf16")
+    _assert_trees_bitexact(bf16_flat_baseline, params_h)
 
 
 # ---------------------------------------------------------------------------

@@ -115,6 +115,36 @@ def test_check_flags_injected_regression():
     assert ledger.check_rows(history, err) == []
 
 
+def test_check_never_compares_across_compute_dtypes():
+    """graftcast: rows are graded only against prior rows of the SAME
+    compute dtype. Pre-graftcast rows (no field) count as bf16 — the
+    only dtype the repo ran before round 8."""
+    history = [
+        # pre-graftcast row: implicitly bf16
+        ledger.normalize_row("c4", {"img_s_per_chip": 44.0, "mfu": 0.28},
+                             round_=4),
+        ledger.normalize_row("c4", {"img_s_per_chip": 25.0, "mfu": 0.30,
+                                    "compute_dtype": "f32"}, round_=6),
+    ]
+    assert ledger.row_dtype(history[0]) == "bf16"
+    # an f32 candidate at half the bf16 throughput is NOT a regression —
+    # its bar is the f32 row, not the bf16 one
+    f32_cand = [ledger.normalize_row(
+        "c4", {"img_s_per_chip": 24.0, "mfu": 0.29,
+               "compute_dtype": "f32"}, round_=7)]
+    assert ledger.check_rows(history, f32_cand, threshold=0.10) == []
+    # a bf16 candidate is graded against the bf16 best (44.0), and the
+    # faster f32-relative number cannot hide the drop
+    bf16_cand = [ledger.normalize_row(
+        "c4", {"img_s_per_chip": 30.0, "mfu": 0.27,
+               "compute_dtype": "bf16"}, round_=7)]
+    problems = ledger.check_rows(history, bf16_cand, threshold=0.10)
+    assert problems and "round 4" in problems[0]
+    # best_prior with an explicit dtype never crosses over
+    best = ledger.best_prior(history, "c4", dtype="f32")
+    assert best["img_s_per_chip"][0] == 25.0
+
+
 def test_check_default_splits_latest_round():
     rows = [
         ledger.normalize_row("c4", {"img_s_per_chip": 44.0}, round_=4),

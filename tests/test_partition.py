@@ -38,7 +38,7 @@ def _vit_cfg(**overrides):
         "network.vit_depth": 2,
         "network.vit_heads": 2,
         "network.vit_window": 4,
-        "network.compute_dtype": "float32",
+        "train.compute_dtype": "f32",
         "network.tensor_parallel": True,
         "train.fpn_rpn_pre_nms_per_level": 64,
         "train.rpn_post_nms_top_n": 64,
@@ -179,7 +179,7 @@ def _detr_tp_cfg(**overrides):
         "network.detr_dec_layers": 2,
         "network.norm": "group",
         "network.freeze_at": 0,
-        "network.compute_dtype": "float32",
+        "train.compute_dtype": "f32",
         "network.tensor_parallel": True,
         "train.max_gt_boxes": 8,
     }
@@ -241,7 +241,7 @@ def test_fpn_fc_head_tp_runs(rng):
         **{
             "image.pad_shape": (128, 128),
             "train.batch_images": 2,
-            "network.compute_dtype": "float32",
+            "train.compute_dtype": "f32",
             "network.tensor_parallel": True,
             "network.norm": "group",
             "network.freeze_at": 0,
@@ -271,7 +271,7 @@ def test_fit_detector_tp_smoke(tmp_path, rng):
 
     cfg = _detr_tp_cfg(**{
         "image.scales": ((128, 128),),
-        "network.compute_dtype": "bfloat16",  # the production dtype path
+        "train.compute_dtype": "bf16",  # the production dtype path
         "train.batch_images": 1,
         "train.flip": False,
         "train.lr_step": (100,),

@@ -116,7 +116,7 @@ def _vit_pp_cfg(pp_stages=2, **overrides):
         "network.vit_depth": 4,
         "network.vit_heads": 2,
         "network.vit_window": 4,
-        "network.compute_dtype": "float32",
+        "train.compute_dtype": "f32",
         "network.pp_stages": pp_stages,
         "train.fpn_rpn_pre_nms_per_level": 64,
         "train.rpn_post_nms_top_n": 64,
@@ -237,8 +237,20 @@ def test_fit_detector_pp_smoke(tmp_path, rng):
     assert (tmp_path / "pp" / "0001").exists()
 
 
+@pytest.fixture(scope="module")
+def seq_vit8():
+    """Depth-8 sequential ViTDet (cfg, model, params) — shared by both
+    stage-count parametrizations of the conversion gate (identical
+    across them; the test never mutates the tree)."""
+    cfg_seq = _vit_pp_cfg(pp_stages=0, **{"network.vit_depth": 8,
+                                          "train.batch_images": 1})
+    model_seq = zoo.build_model(cfg_seq)
+    params_seq = zoo.init_params(model_seq, cfg_seq, jax.random.PRNGKey(0))
+    return cfg_seq, model_seq, params_seq
+
+
 @pytest.mark.parametrize("stages_n", [2, 4])
-def test_sequential_to_staged_checkpoint_conversion(rng, stages_n):
+def test_sequential_to_staged_checkpoint_conversion(rng, seq_vit8, stages_n):
     """A sequentially-trained ViTDet param tree converts to the staged/PP
     layout with identical numerics (and back, bit-exact round trip) for
     EVERY supported stage count — the staged model preserves the
@@ -247,20 +259,19 @@ def test_sequential_to_staged_checkpoint_conversion(rng, stages_n):
     from mx_rcnn_tpu.models.vit import (
         sequential_to_staged, staged_to_sequential)
 
-    cfg_seq = _vit_pp_cfg(pp_stages=0, **{"network.vit_depth": 8,
-                                          "train.batch_images": 1})
+    cfg_seq, model_seq, params_seq = seq_vit8
     cfg_pp = _vit_pp_cfg(pp_stages=stages_n, **{"network.vit_depth": 8,
                                                 "train.batch_images": 1})
-    model_seq = zoo.build_model(cfg_seq)
-    params_seq = zoo.init_params(model_seq, cfg_seq, jax.random.PRNGKey(0))
     staged = sequential_to_staged(params_seq, stages_n)
 
     model_pp = zoo.build_model(cfg_pp)  # no mesh: sequential staged exec
     batch = _batch(rng, b=1)
-    l_seq, _ = zoo.forward_train(model_seq, params_seq, batch,
-                                 jax.random.PRNGKey(3), cfg_seq)
-    l_pp, _ = zoo.forward_train(model_pp, staged, batch,
-                                jax.random.PRNGKey(3), cfg_pp)
+    l_seq, _ = jax.jit(
+        lambda p, b, r: zoo.forward_train(model_seq, p, b, r, cfg_seq)
+    )(params_seq, batch, jax.random.PRNGKey(3))
+    l_pp, _ = jax.jit(
+        lambda p, b, r: zoo.forward_train(model_pp, p, b, r, cfg_pp)
+    )(staged, batch, jax.random.PRNGKey(3))
     np.testing.assert_allclose(float(l_pp), float(l_seq), rtol=1e-6)
 
     # Bit-exact round trip.
@@ -269,14 +280,11 @@ def test_sequential_to_staged_checkpoint_conversion(rng, stages_n):
                  params_seq, back)
 
 
-def test_sequential_to_staged_rejects_mismatched_layout(rng):
+def test_sequential_to_staged_rejects_mismatched_layout(rng, seq_vit8):
     from mx_rcnn_tpu.models.vit import (
         sequential_to_staged, staged_to_sequential)
 
-    cfg_seq = _vit_pp_cfg(pp_stages=0, **{"network.vit_depth": 8,
-                                          "train.batch_images": 1})
-    model_seq = zoo.build_model(cfg_seq)
-    params_seq = zoo.init_params(model_seq, cfg_seq, jax.random.PRNGKey(0))
+    _, _, params_seq = seq_vit8
     # 8 stages over depth 8 (per=1): sequential globals {1,3,5,7} give
     # alternating empty/global per-stage patterns — not preservable.
     with pytest.raises(ValueError, match="preserve"):

@@ -509,17 +509,22 @@ def _assert_trees_bitexact(a, b):
                                       err_msg=jax.tree_util.keystr(path))
 
 
-def _parity(tmp_path, monkeypatch, flat):
+def _parity(tmp_path, monkeypatch, flat, compute="f32", params_u=None):
     """SIGTERM at global step 4 (mid-epoch 1 of 2x3) -> PreemptionExit
     rc 75 with a dispatch-tagged emergency save and a `preempt` event;
-    --resume auto then reaches params BIT-exact vs uninterrupted."""
-    params_u = driver.run_fit(str(tmp_path / "uninterrupted"), flat=flat)
+    --resume auto then reaches params BIT-exact vs uninterrupted
+    (``params_u`` supplies a precomputed uninterrupted baseline — the
+    session-scope bf16 one is shared with test_heal.py)."""
+    if params_u is None:
+        params_u = driver.run_fit(str(tmp_path / "uninterrupted"),
+                                  flat=flat, compute=compute)
 
     monkeypatch.setenv(chaos.ENV_VAR, "sigterm_at_step=4")
     chaos.reset()
     obs_dir = str(tmp_path / "obs_interrupted")
     with pytest.raises(PreemptionExit) as ei:
-        driver.run_fit(str(tmp_path / "killed"), flat=flat, obs_dir=obs_dir)
+        driver.run_fit(str(tmp_path / "killed"), flat=flat, obs_dir=obs_dir,
+                       compute=compute)
     assert ei.value.code == RESUMABLE_RC
     assert latest_checkpoint(str(tmp_path / "killed")) == (1, 1)
     assert os.path.isdir(tmp_path / "killed" / "0001d00001")
@@ -532,7 +537,8 @@ def _parity(tmp_path, monkeypatch, flat):
     chaos.reset()
     obs_resumed = str(tmp_path / "obs_resumed")
     params_r = driver.run_fit(str(tmp_path / "killed"), flat=flat,
-                              resume="auto", obs_dir=obs_resumed)
+                              resume="auto", obs_dir=obs_resumed,
+                              compute=compute)
     _assert_trees_bitexact(params_u, params_r)
     # telemetry indices CONTINUE at the skip point (dispatch 1 of the
     # interrupted epoch) — no double-use of batch numbers the
@@ -553,6 +559,18 @@ def test_kill_resume_parity_flat(tmp_path, monkeypatch):
     emergency save is TREE-form even from flat buffers, and the resumed
     flat run still matches uninterrupted bit for bit."""
     _parity(tmp_path, monkeypatch, flat=True)
+
+
+@pytest.mark.compile_heavy
+def test_kill_resume_parity_bf16(tmp_path, monkeypatch, bf16_flat_baseline):
+    """graftcast under interruption: compute_dtype=bf16 + flat — the
+    emergency save is f32 TREE-form (masters only; the compute shadow is
+    derived state), the resumed session re-cuts buffers AND re-derives
+    the shadow from the restored masters, and the whole thing is still
+    bit-exact vs an uninterrupted bf16 run (bf16 rounding is
+    deterministic on a fixed backend)."""
+    _parity(tmp_path, monkeypatch, flat=True, compute="bf16",
+            params_u=bf16_flat_baseline)
 
 
 @pytest.mark.compile_heavy

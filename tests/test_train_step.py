@@ -325,8 +325,8 @@ def _accum_cfg(**train_over):
             "image.pad_shape": (64, 64),
         })
     return cfg.with_updates(
-        network=replace(cfg.network, compute_dtype="float32"),
-        train=replace(cfg.train, **{"grad_accum_steps": 2, **train_over}))
+        train=replace(cfg.train, **{"compute_dtype": "f32",
+                                    "grad_accum_steps": 2, **train_over}))
 
 
 def _accum_batch(b):

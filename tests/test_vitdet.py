@@ -210,7 +210,7 @@ def test_ulysses_attention_matches_dense(rng):
     # making an rtol comparison of the post-selection losses flaky.
     cfg = tiny_cfg(**{"network.use_ring_attention": True,
                       "network.sp_mode": "ulysses",
-                      "network.compute_dtype": "float32"})
+                      "train.compute_dtype": "f32"})
     mesh = create_mesh("1x2")
     model_sp = zoo.build_model(cfg, mesh=mesh)
     cfg_dense = cfg.with_updates(
