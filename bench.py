@@ -617,12 +617,19 @@ def main():
         prior = perf_ledger.latest_round(perf_ledger.load_rows(ledger_path))
         bench_round = (prior + 1) if prior is not None else None
     ledger_sha = perf_ledger._git_sha()
+    # graftpulse: every live row carries the env fingerprint (jax/jaxlib
+    # versions, git_dirty) so `ledger check` regressions are attributable
+    # to environment drift, not just the sha (obs/events.py).
+    from mx_rcnn_tpu.obs import env_fingerprint
+
+    env_fields = env_fingerprint()
 
     def ledger_row(name, row):
         if not ledger_path:
             return
         perf_ledger.append_rows(ledger_path, [perf_ledger.normalize_row(
-            name, row, round_=bench_round, sha=ledger_sha, source="bench")])
+            name, dict(env_fields, **row), round_=bench_round,
+            sha=ledger_sha, source="bench")])
 
     detail = run_sweep(configs, bench_config, elog=elog,
                        flush_path=flush_path, timeout_s=timeout_s,

@@ -11,6 +11,7 @@ from mx_rcnn_tpu.analysis.rules import (
     dtype_cast,
     excepts,
     flat_state,
+    health_pull,
     host_sync,
     obs_schema,
     prng,
@@ -32,6 +33,7 @@ ALL_RULES = (
     retry,
     chaos_site,
     dtype_cast,
+    health_pull,
 )
 
 __all__ = ["ALL_RULES"]

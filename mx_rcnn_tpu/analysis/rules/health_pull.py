@@ -1,0 +1,127 @@
+"""health-host-pull: ad-hoc numerics probes outside the health pipeline.
+
+graftpulse (train/health.py + obs/health.py) computes numerics health —
+nonfinite counts, norms — INSIDE the compiled step, one fused reduction
+per flat buffer, returned as extra step outputs so the host reads them
+at a cadence with zero added syncs. The tempting alternative is an
+ad-hoc probe at the call site: ``jnp.isnan(grads).any()`` inside a
+jitted helper (a second reduction pass XLA may not fuse, invisible to
+the HealthMonitor's tripwires/flight recorder), or worse
+``jnp.isfinite(loss).item()`` (a device→host sync on EVERY step — the
+exact per-step stall graftscope's StepTimer was built to keep out of the
+hot loop). Both shapes rot independently of the sanctioned pipeline:
+their readings reach nobody's trailing window, trip no checkpoint, and
+land in no flight dump.
+
+Flagged, when reachable from a jit root and outside the sanctioned
+``mx_rcnn_tpu/train/health.py``:
+
+- a REDUCTION of a finiteness probe — ``jnp.any/all/sum/...`` (or the
+  ``.any()/.all()/.sum()`` method spellings) over ``jnp.isnan`` /
+  ``jnp.isfinite`` / ``jnp.isinf`` (np/numpy/jax.numpy spellings and
+  ``from jax.numpy import isnan`` aliases included);
+- a HOST PULL of a probe — ``.item()`` / ``float()`` / ``bool()`` whose
+  argument contains one.
+
+Not flagged: algorithmic masks — ``jnp.where(jnp.isfinite(x), x, 0)``
+and boolean-mask arithmetic (ops/matching.py, ops/roi_align.py) consume
+the elementwise probe WITHOUT reducing it to a scalar health signal;
+host-side test assertions (not trace-reachable); and train/health.py
+itself, the one sanctioned home of in-graph health reductions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import dotted_name
+
+NAME = "health-host-pull"
+RATIONALE = ("ad-hoc `jnp.isnan`/`jnp.isfinite` probe reductions (or "
+             "`.item()` pulls of them) in traced code bypass the fused "
+             "graftpulse health outputs — route numerics probes through "
+             "train/health.py so they ride the step's existing fetch")
+
+#: the sanctioned home of in-graph health reductions
+_SANCTIONED = "mx_rcnn_tpu/train/health.py"
+
+#: finiteness probes, module-qualified
+_PROBE_NAMES = frozenset({"isnan", "isfinite", "isinf"})
+_PROBES = frozenset(
+    f"{mod}.{name}"
+    for mod in ("jnp", "jax.numpy", "np", "numpy")
+    for name in _PROBE_NAMES)
+
+#: reductions that fold an elementwise probe into a scalar health signal
+_REDUCERS = frozenset(
+    f"{mod}.{name}"
+    for mod in ("jnp", "jax.numpy", "np", "numpy")
+    for name in ("any", "all", "sum", "mean", "max", "min",
+                 "count_nonzero"))
+_REDUCER_METHODS = frozenset({"any", "all", "sum", "mean", "max", "min"})
+
+
+def _probe_aliases(tree: ast.AST) -> frozenset:
+    """Bare names bound to probes via ``from jax.numpy import isnan``
+    (aliases included) — same coverage contract as time-in-jit."""
+    names = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom)
+                and node.module in ("jax.numpy", "numpy")):
+            for alias in node.names:
+                if alias.name in _PROBE_NAMES:
+                    names.add(alias.asname or alias.name)
+    return frozenset(names)
+
+
+def _contains_probe(expr: ast.AST, aliases: frozenset) -> bool:
+    for n in ast.walk(expr):
+        if not isinstance(n, ast.Call):
+            continue
+        if dotted_name(n.func) in _PROBES:
+            return True
+        if isinstance(n.func, ast.Name) and n.func.id in aliases:
+            return True
+    return False
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    if ctx.rel_path == _SANCTIONED:
+        return
+    traced = ctx.traced
+    if not traced.traced:
+        return
+    aliases = _probe_aliases(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not traced.in_traced_code(node):
+            continue
+        # <probe-expr>.item() / <probe-expr>.any() — method spellings
+        if (isinstance(node.func, ast.Attribute) and not node.args
+                and not node.keywords
+                and node.func.attr in (_REDUCER_METHODS | {"item"})
+                and _contains_probe(node.func.value, aliases)):
+            yield ctx.finding(
+                NAME, node,
+                f"`.{node.func.attr}()` over a finiteness probe in traced "
+                "code is an ad-hoc health reduction — route it through "
+                "train/health.py's fused step outputs")
+            continue
+        name = dotted_name(node.func)
+        if (name in _REDUCERS and node.args
+                and _contains_probe(node.args[0], aliases)):
+            yield ctx.finding(
+                NAME, node,
+                f"`{name}` over a finiteness probe in traced code is an "
+                "ad-hoc health reduction — route it through "
+                "train/health.py's fused step outputs")
+        elif (name in ("float", "int", "bool") and node.args
+              and _contains_probe(node.args[0], aliases)):
+            yield ctx.finding(
+                NAME, node,
+                f"`{name}()` of a finiteness probe is a per-step "
+                "device→host numerics pull — use the HealthMonitor's "
+                "cadenced read over train/health.py outputs instead")

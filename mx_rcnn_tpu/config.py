@@ -401,6 +401,33 @@ class ObsConfig:
     # fires, independent of this knob.
     trace_at_step: int = 0
     trace_steps: int = 3
+    # graftpulse (train/health.py + obs/health.py): in-graph numerics
+    # health. health_every=N computes per-buffer nonfinite counts and
+    # grad/param/update norms INSIDE the compiled step (same executable,
+    # zero added per-step host syncs) and folds them into a `health`
+    # event every N dispatches; 0 = off (the step program is then
+    # bit-identical to pre-graftpulse). Tripwires — any nonfinite, a
+    # grad-norm explosion past health_grad_factor x the trailing median,
+    # or a loss z-score beyond health_loss_z vs the health_window
+    # trailing readings — emit an `anomaly` event, arm one jax.profiler
+    # window, dump the flight-recorder ring and (health_checkpoint)
+    # write an emergency checkpoint of the last known-good state; then
+    # health_action "abort" raises NumericsAnomaly (restart with
+    # --resume auto) while "warn" keeps training. Runbook: OUTAGES.md
+    # "run went nonfinite".
+    health_every: int = 0
+    health_window: int = 64
+    health_grad_factor: float = 100.0
+    health_loss_z: float = 10.0
+    health_action: str = "abort"
+    # Refresh a host-side known-good snapshot after each CLEAN health
+    # check (one device_get per interval — size health_every
+    # accordingly) and save it as the emergency checkpoint on anomaly.
+    health_checkpoint: bool = True
+    # Flight recorder: capacity of the last-K in-memory event ring
+    # dumped to <obs dir>/flight_<reason>.json on anomaly/stall/heal/
+    # preempt/crash (obs/health.py FlightRecorder).
+    flight_events: int = 256
 
 
 @dataclass(frozen=True)

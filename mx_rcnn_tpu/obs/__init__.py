@@ -41,6 +41,7 @@ from mx_rcnn_tpu.obs.events import (
     EVENT_TYPES,
     EventLog,
     NullEventLog,
+    env_fingerprint,
     event_log_path,
     open_event_log,
     run_meta_fields,

@@ -62,6 +62,14 @@ EVENT_TYPES = (
                    # compiled shape bucket (flops, hbm split — obs/costs.py)
     "trace",       # graftprof: one closed jax.profiler capture window
                    # (dir + coarse phase summary — obs/profile.py)
+    "health",      # graftpulse: one cadenced numerics reading — loss (+
+                   # z-score), per-buffer grad/param/update norms and
+                   # nonfinite counts (obs/health.py HealthMonitor over
+                   # train/health.py's in-graph reductions)
+    "anomaly",     # graftpulse tripwire: a health reading crossed a
+                   # tripwire (nonfinite, grad explosion, loss z-score)
+                   # — reasons, emergency-checkpoint path, flight-dump
+                   # path (obs/health.py)
 )
 
 #: Buffered kinds — everything else flushes to disk immediately, so the
@@ -96,6 +104,9 @@ class NullEventLog:
     def emit(self, type_: str, **fields):
         return None
 
+    def attach_ring(self, ring):
+        return None
+
     def set_step(self, step: int):
         return None
 
@@ -124,7 +135,15 @@ class EventLog:
         self.step = 0
         self._lock = threading.Lock()
         self._buf: list = []
+        self._ring = None
         self._fh: Optional[io.TextIOBase] = open(path, "a", encoding="utf-8")
+
+    def attach_ring(self, ring):
+        """graftpulse flight recorder (obs/health.py FlightRecorder):
+        every emitted record is ALSO appended to ``ring`` — at emit time,
+        before any disk buffering, so the crash-time dump holds the step/
+        compile records the flush cadence has not written yet."""
+        self._ring = ring
 
     def set_step(self, step: int):
         """Update the global step counter stamped on subsequent records
@@ -147,6 +166,8 @@ class EventLog:
             "step": self.step,
         }
         record.update(fields)
+        if self._ring is not None:
+            self._ring.append(record)
         line = json.dumps(record, default=_json_default)
         with self._lock:
             if self._fh is None:
@@ -221,10 +242,54 @@ def _git_sha(start: str) -> Optional[str]:
         cur = nxt
 
 
+def _git_dirty(start: str) -> Optional[bool]:
+    """Best-effort working-tree dirtiness: one ``git status --porcelain``
+    capped at 5 s (the only subprocess in this module — the sha reader
+    stays file-based). None when git is unavailable, times out, or the
+    path is not a work tree: run_meta omits unknowns rather than guess."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "-C", start, "status", "--porcelain"],
+            capture_output=True, timeout=5)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    return bool(proc.stdout.strip())
+
+
+def env_fingerprint() -> Dict[str, Any]:
+    """The environment-drift fields (graftpulse satellite): jax/jaxlib
+    versions plus a ``git_dirty`` flag, so a cross-run regression in the
+    perf ledger is attributable to environment change — an upgraded
+    jaxlib or an uncommitted local patch — not just the git sha. Stamped
+    into ``run_meta`` and into every bench/ledger row (bench.py)."""
+    fields: Dict[str, Any] = {}
+    try:
+        import jax
+
+        fields["jax_version"] = jax.__version__
+    except ImportError:
+        pass  # jax-free caller — the fingerprint stays partial
+    try:
+        import jaxlib
+
+        fields["jaxlib_version"] = jaxlib.__version__
+    except (ImportError, AttributeError):
+        pass
+    dirty = _git_dirty(os.path.dirname(os.path.abspath(__file__)))
+    if dirty is not None:
+        fields["git_dirty"] = dirty
+    return fields
+
+
 def run_meta_fields(cfg=None, mesh=None, **extra) -> Dict[str, Any]:
-    """The ``run_meta`` payload: config digest, mesh shape, jax versions,
-    git sha. ``cfg``/``mesh`` are optional so jax-free tools (report) and
-    config-free tools (bench across many configs) can still stamp a run."""
+    """The ``run_meta`` payload: config digest, mesh shape, jax/jaxlib
+    versions, git sha + dirtiness. ``cfg``/``mesh`` are optional so
+    jax-free tools (report) and config-free tools (bench across many
+    configs) can still stamp a run."""
     fields: Dict[str, Any] = {}
     if cfg is not None:
         # repr of the frozen dataclass tree is a stable, total rendering
@@ -236,10 +301,10 @@ def run_meta_fields(cfg=None, mesh=None, **extra) -> Dict[str, Any]:
     if mesh is not None:
         fields["mesh"] = dict(
             zip(mesh.axis_names, (int(s) for s in mesh.devices.shape)))
+    fields.update(env_fingerprint())
     try:
         import jax
 
-        fields["jax_version"] = jax.__version__
         fields["backend"] = jax.default_backend()
         fields["device_count"] = jax.device_count()
     except (ImportError, RuntimeError):

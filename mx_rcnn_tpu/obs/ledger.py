@@ -45,7 +45,15 @@ _METRIC_FIELDS = (
     "img_s_per_chip", "mfu", "step_ms", "hbm_bytes", "pad_waste",
     "compile_s", "n_executables", "tree_ms", "flat_ms", "speedup",
     "ms_per_img", "error", "timeout_s", "compute_dtype",
+    # environment-drift attribution (graftpulse satellite): a cross-run
+    # regression should be pinnable to an env change — jaxlib upgrade,
+    # uncommitted local patch — not just the git sha. bench.py stamps
+    # these into every live row (events.env_fingerprint); blob-level
+    # values propagate to rows in rows_from_artifact.
+    "jax_version", "jaxlib_version", "git_dirty",
 )
+#: blob-level env fields copied down onto every row they wrap
+_ENV_FIELDS = ("jax_version", "jaxlib_version", "git_dirty")
 #: the two regression-gated metrics (higher is better for both)
 _GATED = ("img_s_per_chip", "mfu")
 
@@ -69,21 +77,14 @@ def default_path() -> str:
 
 
 def load_rows(path: str) -> List[Dict[str, Any]]:
-    """Parse the ledger JSONL; a torn tail line is skipped (same contract
-    as obs.report.load_events — appends can race a kill)."""
+    """Parse the ledger JSONL; a torn tail line — SIGKILL mid-append —
+    is skipped WITH a warning, never fatal (the shared
+    obs.report.load_jsonl_tolerant contract)."""
     if not os.path.exists(path):
         return []
-    rows = []
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rows.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
-    return rows
+    from mx_rcnn_tpu.obs.report import load_jsonl_tolerant
+
+    return load_jsonl_tolerant(path, hint="append")
 
 
 def append_rows(path: str, rows: Iterable[Dict[str, Any]]) -> int:
@@ -137,7 +138,11 @@ def rows_from_artifact(blob: Any, round_: Optional[int] = None,
                          error=f"rc={blob.get('rc')} (no parsed output)")]
         blob = parsed
     rows: List[Dict[str, Any]] = []
+    env: Dict[str, Any] = {}
     if "value" in blob and "metric" in blob:  # printed bench line
+        # blob-level env fingerprint (report.bench_blob): applies to
+        # every row the blob wraps — copied down after normalization.
+        env = {k: blob[k] for k in _ENV_FIELDS if k in blob}
         rows.append(normalize_row(
             "headline",
             {"img_s_per_chip": blob.get("value"), "mfu": blob.get("mfu")},
@@ -148,6 +153,9 @@ def rows_from_artifact(blob: Any, round_: Optional[int] = None,
     for config, row in blob.items():
         if isinstance(row, dict):
             rows.append(normalize_row(config, row, round_, sha, source))
+    for r in rows:
+        for k, v in env.items():
+            r.setdefault(k, v)
     return rows
 
 

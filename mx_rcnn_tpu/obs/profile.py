@@ -120,6 +120,7 @@ class TraceController:
         self._active_reason: Optional[str] = None
         self._stop_after: Optional[int] = None
         self._stall_used = False
+        self._anomaly_used = False
 
     # -- capture plumbing ---------------------------------------------------
 
@@ -180,6 +181,17 @@ class TraceController:
             if self._active_dir is not None and (
                     self._stop_after is None or step >= self._stop_after):
                 self._stop_and_emit()
+
+    def anomaly_window(self):
+        """graftpulse tripwire hook (obs/health.py): like stall_window,
+        at most ONE anomaly window per run — armed before the anomaly
+        event is written so the capture brackets whatever the diverging
+        run does next; closed at the next completed step or at close()."""
+        with self._lock:
+            if self._anomaly_used or self._active_dir is not None:
+                return
+            self._anomaly_used = True
+            self._start("anomaly", reason="anomaly")
 
     def stall_window(self):
         """Watchdog hook: open ONE trace window for the stall in flight.

@@ -18,23 +18,36 @@ import sys
 from typing import Any, Dict, List, Optional
 
 
-def load_events(path: str) -> List[Dict[str, Any]]:
-    """Parse one JSONL file (or a run dir holding events.jsonl). A
-    truncated final line — the normal signature of a killed run — is
-    skipped, not fatal."""
-    if os.path.isdir(path):
-        path = os.path.join(path, "events.jsonl")
-    events = []
+def load_jsonl_tolerant(path: str, hint: str = "run") -> List[Dict[str, Any]]:
+    """Parse a JSONL file whose appends can race a kill: an unparseable
+    line — the normal signature of SIGKILL mid-append — is skipped WITH
+    a stderr warning (a silently half-read stream would fold a killed
+    run into a clean-looking artifact), never fatal. Shared by this
+    module's event streams and obs/ledger.py's perf rows (``hint``
+    names what was being appended, for the warning)."""
+    records = []
+    skipped = 0
     with open(path, encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
             if not line:
                 continue
             try:
-                events.append(json.loads(line))
+                records.append(json.loads(line))
             except json.JSONDecodeError:
-                continue  # torn tail write of a killed run
-    return events
+                skipped += 1  # torn tail write of a killed process
+    if skipped:
+        print(f"warning: {path}: skipped {skipped} unparseable JSONL "
+              f"line(s) — torn tail of a killed {hint}?", file=sys.stderr)
+    return records
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Parse one JSONL event file (or a run dir holding events.jsonl),
+    tolerating a torn tail line (load_jsonl_tolerant)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    return load_jsonl_tolerant(path, hint="run")
 
 
 def _percentile(sorted_vals: List[float], pct: float) -> float:
@@ -136,12 +149,15 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     pad_waste = (round(_percentile(pad_vals, 50), 4) if pad_vals else None)
 
     crash = (by_type.get("crash") or [None])[-1]
+    # graftpulse: cadenced numerics readings + tripped anomalies
+    health_evs = by_type.get("health", ())
+    last_health = health_evs[-1] if health_evs else None
     summary: Dict[str, Any] = {
         "run": {k: run_meta.get(k) for k in
                 ("config_digest", "network", "dataset", "mesh",
-                 "jax_version", "backend", "device_count", "git_sha",
-                 "batch_size", "steps_per_epoch", "prefix", "tool",
-                 "compute_dtype")
+                 "jax_version", "jaxlib_version", "git_dirty", "backend",
+                 "device_count", "git_sha", "batch_size",
+                 "steps_per_epoch", "prefix", "tool", "compute_dtype")
                 if k in run_meta},
         "events": len(events),
         "steps": len(timed),
@@ -177,6 +193,23 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                                 "step", "config")}
                   for i, e in enumerate(by_type.get("bench", ()))},
         "stalls": len(by_type.get("stall", ())),
+        # graftpulse: how many health readings the run folded, how many
+        # saw a nonfinite count, and the last reading's numbers (the
+        # first thing the "run went nonfinite" runbook reads).
+        "health": {
+            "checks": len(health_evs),
+            "nonfinite_checks": sum(
+                1 for e in health_evs
+                if any((e.get("nonfinite") or {}).values())),
+            "last": ({k: last_health.get(k) for k in
+                      ("loss", "loss_z", "grad_norm", "nonfinite")}
+                     if last_health else None),
+        },
+        "anomalies": [{"step": e.get("step"), "epoch": e.get("epoch"),
+                       "dispatch": e.get("dispatch"),
+                       "reasons": e.get("reasons"),
+                       "saved": e.get("saved"), "flight": e.get("flight")}
+                      for e in by_type.get("anomaly", ())],
         # graftguard: how hard the backend fought acquisition, and whether
         # the run was preempted (OUTAGES.md reads these three lines first).
         "backend": {
@@ -231,6 +264,14 @@ def bench_blob(summary: Dict[str, Any]) -> Dict[str, Any]:
         "mfu": summary["cost"]["mfu"],
         "hbm_bytes": summary["cost"]["hbm_bytes"],
         "pad_waste": summary["pad_waste"],
+        # graftpulse: anomaly accounting + the environment-drift fields
+        # (jax/jaxlib/git_dirty ride into ledger rows via this blob, so
+        # a cross-run regression is attributable to env change too).
+        "anomaly_count": len(summary["anomalies"]),
+        "health_checks": summary["health"]["checks"],
+        **{k: summary["run"][k]
+           for k in ("jax_version", "jaxlib_version", "git_dirty")
+           if k in summary["run"]},
         "detail": summary,
     }
 
@@ -287,6 +328,21 @@ def render(summary: Dict[str, Any]) -> str:
     for p in summary.get("preempts", ()):
         lines.append(f"  preempt:    signal {p['signal']} at step "
                      f"{p['step']} (emergency save: {p['saved']})")
+    hl = summary.get("health", {})
+    if hl.get("checks"):
+        last = hl.get("last") or {}
+        z = last.get("loss_z")
+        lines.append(
+            f"  health:     {hl['checks']} reading(s), "
+            f"{hl['nonfinite_checks']} with nonfinites | last: loss "
+            f"{last.get('loss')}"
+            + (f" (z {z})" if z is not None else "")
+            + f", grad norm {last.get('grad_norm')}")
+    for a in summary.get("anomalies", ()):
+        lines.append(
+            f"  ANOMALY:    epoch {a.get('epoch')} dispatch "
+            f"{a.get('dispatch')}: {'; '.join(a.get('reasons') or ())} | "
+            f"checkpoint {a.get('saved')} | flight {a.get('flight')}")
     he = summary.get("heals", {})
     if he.get("count"):
         shrink = (", shrink " + ", ".join(he["shrinks"])

@@ -57,13 +57,17 @@ class StallWatchdog:
 
     def __init__(self, log: EventLog, stall_factor: float = 10.0,
                  min_stall_s: float = 60.0, poll_s: float = 5.0,
-                 window: int = 101, tracer=None):
+                 window: int = 101, tracer=None, recorder=None):
         """``tracer``: optional graftprof TraceController — when a stall
         fires, ONE jax.profiler window is auto-armed before the stack
         dump (``tracer.stall_window()``), so a hung run leaves a trace
-        of the stall alongside the stacks (obs/profile.py)."""
+        of the stall alongside the stacks (obs/profile.py).
+        ``recorder``: optional graftpulse FlightRecorder — the stall dump
+        also flushes the last-K-events ring (obs/health.py), so the
+        artifact says what the numbers were doing when the run hung."""
         self.log = log
         self.tracer = tracer
+        self.recorder = recorder
         self.stall_factor = float(stall_factor)
         self.min_stall_s = float(min_stall_s)
         self.poll_s = float(poll_s)
@@ -150,6 +154,10 @@ class StallWatchdog:
             threshold_s=round(threshold, 3),
             median_step_s=round(median, 4) if median is not None else None,
             stacks=_stack_dump(skip_ident=self._thread.ident))
+        if self.recorder is not None:
+            # after the emit: the flight ring then includes the stall
+            # record itself alongside the recent step/health events
+            self.recorder.dump("stall")
         return True
 
     def _run(self):

@@ -15,6 +15,8 @@ travels in the ``MX_RCNN_CHAOS`` environment variable so subprocess tests
     MX_RCNN_CHAOS="device_lost_at_step=4"          # backend dies mid-run
     MX_RCNN_CHAOS="device_lost_at_step=4 shrink_on_reacquire=4"  # ...and
                                                    # returns with 4 devices
+    MX_RCNN_CHAOS="nan_at_step=5"                  # poison step 5's grads
+                                                   # in-graph (graftpulse)
 
 Pairs are space- or comma-separated ``key=value``; unknown keys raise (a
 typo'd injection silently doing nothing would un-test the gate it was
@@ -52,6 +54,9 @@ SITES = frozenset({
                              # device_lost_at_step loss fires here
     "backend_reacquire",     # heal re-acquisition: shrink_on_reacquire
                              # truncates the recovered device list here
+    "grad_inject",           # train-step build: nan_at_step's IN-GRAPH
+                             # gradient poisoning is traced in here
+                             # (train/step.py; fires once, at build time)
 })
 
 #: Per-process injection state (e.g. how many backend probes have already
@@ -94,6 +99,13 @@ class ChaosSpec:
     #: the first N devices — the backend "returns smaller" (spot reclaim
     #: / partial slice), forcing the elastic re-shard path.
     shrink_on_reacquire: int = 0
+    #: Poison the gradients of optimizer step K with NaN, IN-GRAPH (the
+    #: bf16-overflow stand-in the graftpulse tripwire must catch). The
+    #: injection is baked into the traced step at build time
+    #: ("grad_inject" site, train/step.py + poison_grads below) and
+    #: fires every time the traced step counter reaches K while armed —
+    #: disarm (unset the env var) before a --resume auto continuation.
+    nan_at_step: int = 0
 
     @property
     def active(self) -> bool:
@@ -220,6 +232,25 @@ def parse(text: str) -> ChaosSpec:
             f"bad {ENV_VAR} die_at site {kw['die_at']!r}; registered "
             f"sites: {sorted(SITES)}")
     return ChaosSpec(**kw)
+
+
+def poison_grads(grads, step, at_step: int):
+    """nan_at_step's IN-GRAPH injection: multiply every floating gradient
+    leaf by a factor that is NaN exactly when the optimizer step being
+    produced (``step + 1``, a TRACED counter) equals ``at_step`` and 1.0
+    otherwise — so the poisoned program is numerically identical to the
+    clean one on every other step, and the nonfinite values flow through
+    the same fused update/health reductions a real bf16 overflow would.
+    Trace-time helper (jax imported lazily: this module stays importable
+    without it); non-float leaves (int dtype groups) pass through."""
+    import jax
+    import jax.numpy as jnp
+
+    factor = jnp.where(step + 1 == at_step, jnp.nan, 1.0)
+    return jax.tree_util.tree_map(
+        lambda g: (g * factor.astype(g.dtype)
+                   if jnp.issubdtype(g.dtype, jnp.floating) else g),
+        grads)
 
 
 def site(name: str, step: int = 0, devices=None):

@@ -106,11 +106,15 @@ class Healer:
     StallWatchdog whose trailing median is reset after each heal.
     """
 
-    def __init__(self, rcfg, elog=None, watchdog=None,
+    def __init__(self, rcfg, elog=None, watchdog=None, recorder=None,
                  clock: Callable[[], float] = time.monotonic):
         self.rcfg = rcfg
         self.elog = elog
         self.watchdog = watchdog
+        # graftpulse flight recorder (obs/health.py): each heal flushes
+        # the last-K-events ring, so the recovery artifact shows the
+        # numerics around the loss, not just the heal event.
+        self.recorder = recorder
         self._clock = clock
         self.heals = 0
         self.devices = None
@@ -230,6 +234,8 @@ class Healer:
                            downtime_s=round(downtime, 3),
                            devices_before=before,
                            devices_after=after)
+        if self.recorder is not None:
+            self.recorder.dump("heal")
         logger.warning(
             "graftheal: healed step-time backend loss at epoch %d dispatch "
             "%d (%s capture, %.1fs down, devices %s -> %d): %s",

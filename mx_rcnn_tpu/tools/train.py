@@ -168,6 +168,17 @@ def fit_detector(
     # wants somewhere to emit backend_retry/backend_up events, so an
     # outage ridden out here leaves a structured record, not a watch log.
     obs_log = obs_from_config(cfg, default_dir=f"{prefix}.obs")
+    # graftpulse flight recorder: every emitted record also lands in a
+    # last-K in-memory ring, dumped to <obs dir>/flight_<reason>.json on
+    # anomaly/stall/heal/preempt/crash — attached before backend
+    # acquisition so even startup retries ride the ring.
+    recorder = None
+    if obs_log.enabled:
+        from mx_rcnn_tpu.obs.health import FlightRecorder
+
+        recorder = FlightRecorder(os.path.dirname(obs_log.path),
+                                  capacity=cfg.obs.flight_events)
+        obs_log.attach_ring(recorder)
     if cfg.resilience.backend_acquire:
         # Classified retry-with-backoff before the first device touch —
         # a transient relay outage (the TPU_OUTAGE_r5 signature) delays
@@ -373,7 +384,8 @@ def fit_detector(
             watchdog = StallWatchdog(
                 obs_log, stall_factor=cfg.obs.stall_factor,
                 min_stall_s=cfg.obs.stall_min_s,
-                poll_s=cfg.obs.watchdog_poll_s, tracer=tracer)
+                poll_s=cfg.obs.watchdog_poll_s, tracer=tracer,
+                recorder=recorder)
             watchdog.start()
     timer = StepTimer(obs_log, watchdog=watchdog,
                       enrich=obs_costs.step_fields if obs_log.enabled
@@ -433,7 +445,8 @@ def fit_detector(
                        "disabled under jax.process_count()=%d",
                        jax.process_count())
     elif cfg.resilience.heal:
-        healer = Healer(cfg.resilience, elog=obs_log, watchdog=watchdog)
+        healer = Healer(cfg.resilience, elog=obs_log, watchdog=watchdog,
+                        recorder=recorder)
         healer.set_fallback(HealCarry(
             params=host_tree_copy(carry.params),
             opt_state=host_tree_copy(carry.opt_state),
@@ -502,11 +515,48 @@ def fit_detector(
                          step=(at_epoch * steps_per_epoch
                                + (at_dispatch or 0) * multi),
                          saved=saved)
+        if recorder is not None:
+            recorder.dump("preempt")
         logger.warning("preempted (signal %s) at epoch %d dispatch %s — "
                        "exiting rc %d; restart with --resume auto",
                        guard.signum, at_epoch, at_dispatch,
                        PreemptionExit().code)
         raise PreemptionExit(guard.signum)
+
+    # graftpulse (obs/health.py + train/health.py): with obs on and
+    # obs.health_every > 0 the step returns an extra in-graph numerics
+    # output (same executable, no added per-step sync); the monitor
+    # folds it into `health` events at the cadence and turns anomalies
+    # into action — anomaly event, trace window, emergency checkpoint
+    # of the last known-good state, flight dump, then NumericsAnomaly
+    # under the default health_action=abort (resume with --resume auto).
+    monitor = None
+    health_on = obs_log.enabled and cfg.obs.health_every > 0
+    if health_on:
+        from mx_rcnn_tpu.obs.health import HealthMonitor
+
+        def _save_good(good):
+            """Emergency checkpoint of the monitor's known-good carry —
+            the graftguard dispatch-tagged shape, so `--resume auto`
+            picks it up like any preemption save."""
+            if not is_primary():
+                return None
+            return save_checkpoint(
+                prefix, good.epoch, good.params, good.opt_state,
+                means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
+                num_classes=cfg.dataset.num_classes,
+                dispatch=good.dispatch,
+                meta=_ckpt_meta(good.epoch, good.dispatch))
+
+        monitor = HealthMonitor(
+            obs_log, every=cfg.obs.health_every,
+            window=cfg.obs.health_window,
+            grad_factor=cfg.obs.health_grad_factor,
+            loss_z=cfg.obs.health_loss_z,
+            action=cfg.obs.health_action,
+            tracer=tracer, recorder=recorder,
+            capture=_capture if cfg.obs.health_checkpoint else None,
+            save=_save_good if cfg.obs.health_checkpoint else None)
 
     try:
         while True:  # one iteration per backend session; graftheal re-enters
@@ -632,7 +682,8 @@ def fit_detector(
                                           forward_fn=(forward_fn
                                                       or forward_train),
                                           param_specs=param_specs,
-                                          flat_core=flat_core)
+                                          flat_core=flat_core,
+                                          health=health_on)
                 # Per-dispatch rng keys are derived from the dispatch's
                 # GLOBAL index (fold_in), not a run-position-dependent
                 # split chain — so a resumed/healed run consumes exactly
@@ -692,7 +743,11 @@ def fit_detector(
                             # Pre-dispatch arming: the window must
                             # INCLUDE step trace_at_step (even step 1).
                             tracer.before_step(timer.total_steps + 1)
-                        state, metrics = step_fn(state, sharded, k)
+                        if health_on:
+                            state, metrics, pulse = step_fn(state, sharded,
+                                                            k)
+                        else:
+                            state, metrics = step_fn(state, sharded, k)
                         pos = (epoch, i + 1)
                         timer.dispatched()
                         bag.update(metrics)
@@ -702,6 +757,14 @@ def fit_detector(
                             # generator resumes — this dispatch is the
                             # (+1)th completed.
                             tracer.step_completed(timer.total_steps + 1)
+                        if monitor is not None:
+                            # stores a reference per dispatch; pulls to
+                            # host (and runs the tripwires) only at the
+                            # obs.health_every cadence. A tripped wire
+                            # raises NumericsAnomaly out of the loop
+                            # AFTER saving the known-good checkpoint.
+                            monitor.observe(pulse, epoch=epoch,
+                                            dispatch=i + 1)
                         done = i + 1  # dispatches complete in this epoch
                         if healer is not None:
                             healer.note_progress()
@@ -799,6 +862,11 @@ def fit_detector(
 
             obs_log.emit("crash", error=repr(exc),
                          traceback=traceback.format_exc())
+            if recorder is not None:
+                # the rc!=0 artifact: the last-K events (incl. any
+                # health readings) around the death, flushed even when
+                # the JSONL buffer was not
+                recorder.dump("crash")
         raise
     finally:
         if guard is not None:

@@ -23,6 +23,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN, forward_train
+from mx_rcnn_tpu.resilience import chaos
+from mx_rcnn_tpu.train import health as health_mod
 
 
 class TrainState(struct.PyTreeNode):
@@ -98,6 +100,7 @@ def make_train_step(
     forward_fn: Callable = forward_train,
     param_specs=None,
     flat_core=None,
+    health: bool = False,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray], jax.Array],
               Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the jitted train step.
@@ -140,10 +143,36 @@ def make_train_step(
     FlatCore.master_grads, and the update re-materializes the shadow
     from the new masters (one cast per buffer, a program output). Tree
     mode under bf16 keeps flax's per-leaf promotion — same values.
+
+    graftpulse (health=True, obs.health_every > 0): the step RETURNS a
+    third output — the numerics health dict of train/health.py
+    (per-flat-buffer / whole-tree nonfinite counts and squared norms of
+    grads, params and the update delta, plus the pooled loss) — computed
+    in-graph and fused into the same executable, so the cadenced host
+    read (obs/health.py) adds no per-step sync and no extra compile.
+    health=False keeps the exact two-output program (bit-identical HLO
+    to pre-graftpulse). Chaos ``nan_at_step=K`` (resilience/chaos.py)
+    poisons step K's final gradients IN-GRAPH here, after the accum fold
+    and the bf16 cast-up — the registered "grad_inject" site, traced in
+    at build time.
     """
 
     accum = max(1, int(getattr(cfg.train, "grad_accum_steps", 1)))
     multi = max(1, int(getattr(cfg.train, "multi_step_dispatch", 1)))
+    # graftpulse chaos: the spec is env-carried and static per process —
+    # parse once at build time; the injection (if armed) is traced into
+    # the step at the registered "grad_inject" site below.
+    _spec = chaos.from_env()
+    nan_at = int(_spec.nan_at_step)
+    if _spec.active:
+        _spec.fire("grad_inject")
+    # graftpulse flat-mode CPU quirk (train/health.py::step_health): the
+    # probed gradient buffers must be program OUTPUTS on the CPU backend
+    # or XLA schedules the backward ~8x slower; pinning under a scan
+    # (multi-step) would stack K grad-sized buffers instead, so the pin
+    # is single-step only.
+    pin_grads = (health and flat_core is not None and multi == 1
+                 and jax.default_backend() == "cpu")
     if flat_core is not None:
         def as_params(diff):
             return flat_core.params_view(*diff) if flat_core.policy.mixed \
@@ -207,10 +236,23 @@ def make_train_step(
                     p_tot = jax.tree.map(jnp.add, p_tot, p)
             grads = jax.tree.map(lambda g: g / accum, g_tot)
             parts = p_tot
-        return state.apply_gradients(grads), parts
+        if nan_at:
+            # chaos nan_at_step: poison the FINAL gradients (post accum
+            # fold / cast-up) of the armed optimizer step, in-graph.
+            grads = chaos.poison_grads(grads, state.step, nan_at)
+        new_state = state.apply_gradients(grads)
+        if not health:
+            return new_state, parts
+        num, den = parts["TotalLoss"]
+        return new_state, parts, health_mod.step_health(
+            state, grads, new_state, flat_core, num / (den + 1e-12),
+            pin_grads=pin_grads)
 
     if multi == 1:
         def step(state: TrainState, batch, rng):
+            if health:
+                new_state, parts, pulse = _one_update(state, batch, rng)
+                return new_state, _finalize_metrics(parts), pulse
             new_state, parts = _one_update(state, batch, rng)
             return new_state, _finalize_metrics(parts)
     else:
@@ -225,9 +267,21 @@ def make_train_step(
 
             def body(st, xs):
                 chunk, key = xs
+                if health:
+                    st, parts, pulse = _one_update(st, chunk, key)
+                    return st, (parts, pulse)
                 st, parts = _one_update(st, chunk, key)
                 return st, parts
 
+            if health:
+                state, (parts_seq, h_seq) = jax.lax.scan(
+                    body, state, (batches, keys))
+                parts = jax.tree.map(lambda x: jnp.sum(x, axis=0),
+                                     parts_seq)
+                # nonfinite counts sum over the K steps; norms/loss keep
+                # the last step's row (train/health.py).
+                return (state, _finalize_metrics(parts),
+                        health_mod.fold_multi_step(h_seq))
             state, parts_seq = jax.lax.scan(body, state, (batches, keys))
             parts = jax.tree.map(lambda x: jnp.sum(x, axis=0), parts_seq)
             return state, _finalize_metrics(parts)
@@ -246,6 +300,6 @@ def make_train_step(
     return jax.jit(
         step,
         in_shardings=(repl, data_sh, repl),
-        out_shardings=(repl, repl),
+        out_shardings=(repl, repl, repl) if health else (repl, repl),
         donate_argnums=(0,) if donate else (),
     )

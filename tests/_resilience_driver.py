@@ -52,7 +52,8 @@ def error_runner(label):
 # the tiny fit (in-process helper + --fit subprocess mode)
 # ---------------------------------------------------------------------------
 
-def tiny_config(flat: bool = False, obs_dir: str = "", compute: str = "f32"):
+def tiny_config(flat: bool = False, obs_dir: str = "", compute: str = "f32",
+                health_every: int = 0):
     """The 64^2 f32 micro-config of tests/test_flatcore.py, plus
     power-of-two bbox stds: the kill->resume parity gates assert BIT
     exactness, and an emergency save round-trips bbox_pred through
@@ -85,6 +86,10 @@ def tiny_config(flat: bool = False, obs_dir: str = "", compute: str = "f32"):
         # per shape bucket — pure compile-time, but these gates are about
         # resilience, not attribution; keep them inside the tier-1 budget.
         over["obs.cost_analysis"] = False
+        # graftpulse: in-graph health at every Nth dispatch (0 = off).
+        # The nan_at_step gates run every=1 so the tripwire sees the
+        # poisoned dispatch the moment it lands.
+        over["obs.health_every"] = health_every
     cfg = generate_config("resnet50", "synthetic", **over)
     return cfg.with_updates(
         train=replace(cfg.train, flat_params=flat, compute_dtype=compute,
@@ -93,7 +98,8 @@ def tiny_config(flat: bool = False, obs_dir: str = "", compute: str = "f32"):
 
 def run_fit(prefix: str, end_epoch: int = 2, resume=False,
             flat: bool = False, obs_dir: str = "", mesh: str = "1",
-            num_images: int = 3, epoch_metrics=None, compute: str = "f32"):
+            num_images: int = 3, epoch_metrics=None, compute: str = "f32",
+            health_every: int = 0):
     """num_images x 64^2, seed 0 — returns the final host params.
     Deterministic end to end, so an interrupted+resumed (or graftheal-ed)
     run must match an uninterrupted one bit for bit. ``mesh`` sizes the
@@ -109,7 +115,8 @@ def run_fit(prefix: str, end_epoch: int = 2, resume=False,
     if epoch_metrics is not None:
         def cb(epoch, state, bag):
             epoch_metrics.append((epoch, bag.get()))
-    return fit_detector(tiny_config(flat, obs_dir, compute), ds.gt_roidb(),
+    return fit_detector(tiny_config(flat, obs_dir, compute, health_every),
+                        ds.gt_roidb(),
                         prefix=prefix, end_epoch=end_epoch, frequent=1000,
                         seed=0, mesh_spec=mesh, resume=resume,
                         epoch_callback=cb)

@@ -42,23 +42,46 @@ def rng():
     return np.random.RandomState(0)
 
 
-@pytest.fixture(scope="session")
-def bf16_flat_baseline(tmp_path_factory):
-    """Uninterrupted flat + compute_dtype=bf16 tiny fit params — the ONE
-    graftcast parity reference shared by the kill→resume gate
-    (tests/test_resilience.py) and the heal-carry gate
-    (tests/test_heal.py). Session scope: both files compare against the
-    bit-identical deterministic run, so a single baseline fit pays for
-    both (tier-1 budget). Armed chaos must not leak into it."""
+def _uninterrupted_fit(tmp_path_factory, name, **kw):
+    """One chaos-clean tiny fit (tests/_resilience_driver.py::run_fit)
+    whose final params serve as a shared bit-exactness baseline. Armed
+    chaos must not leak into it."""
     import _resilience_driver as driver
     from mx_rcnn_tpu.resilience import chaos
 
     old = os.environ.pop(chaos.ENV_VAR, None)
     chaos.reset()
     try:
-        prefix = str(tmp_path_factory.mktemp("bf16_base") / "u_bf16")
-        return driver.run_fit(prefix, flat=True, compute="bf16")
+        prefix = str(tmp_path_factory.mktemp(name) / "u")
+        return driver.run_fit(prefix, **kw)
     finally:
         if old is not None:
             os.environ[chaos.ENV_VAR] = old
         chaos.reset()
+
+
+@pytest.fixture(scope="session")
+def bf16_flat_baseline(tmp_path_factory):
+    """Uninterrupted flat + compute_dtype=bf16 tiny fit params — the ONE
+    graftcast parity reference shared by the kill→resume gate
+    (tests/test_resilience.py), the heal-carry gate (tests/test_heal.py)
+    and the graftpulse nan→resume gate (tests/test_health.py). Session
+    scope: all compare against the bit-identical deterministic run, so a
+    single baseline fit pays for every consumer (tier-1 budget)."""
+    return _uninterrupted_fit(tmp_path_factory, "bf16_base",
+                              flat=True, compute="bf16")
+
+
+@pytest.fixture(scope="session")
+def tree_f32_baseline(tmp_path_factory):
+    """Uninterrupted tree-mode f32 tiny fit params — shared by the
+    SIGTERM kill→resume parity gate (tests/test_resilience.py) and the
+    graftpulse nan→resume gate (tests/test_health.py)."""
+    return _uninterrupted_fit(tmp_path_factory, "tree_base", flat=False)
+
+
+@pytest.fixture(scope="session")
+def flat_f32_baseline(tmp_path_factory):
+    """Uninterrupted flat-mode f32 tiny fit params — same sharing
+    contract as tree_f32_baseline."""
+    return _uninterrupted_fit(tmp_path_factory, "flat_base", flat=True)

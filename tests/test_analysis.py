@@ -1062,3 +1062,86 @@ def test_dtype_cast_out_of_scope_outside_model_code():
             return x.astype(jnp.float32)
     """)
     assert "dtype-cast-in-jit" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# health-host-pull
+# ---------------------------------------------------------------------------
+
+def test_health_pull_flags_probe_reduction_in_jit():
+    """The ad-hoc in-graph probe: a reduction over isnan/isfinite inside
+    traced code — both the jnp.any(...) and the .any() method spelling."""
+    findings = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(state, grads):
+            bad = jnp.any(jnp.isnan(grads))
+            also_bad = jnp.isfinite(grads).all()
+            return state, bad, also_bad
+    """)
+    flagged = [f for f in findings if f.rule == "health-host-pull"]
+    assert len(flagged) == 2
+    assert "train/health.py" in flagged[0].message
+
+
+def test_health_pull_flags_item_pull_and_from_import():
+    """The per-step host pull — float()/.item() of a probe — including
+    the from-import alias spelling, via same-module trace reachability."""
+    findings = lint("""
+        from jax.numpy import isnan as nan_probe
+
+        import jax
+        import jax.numpy as jnp
+
+        def _monitor(loss):
+            return float(jnp.sum(nan_probe(loss)))
+
+        def step(state, loss):
+            return state, _monitor(loss)
+
+        run = jax.jit(step)
+    """)
+    assert "health-host-pull" in rules_of(findings)
+
+
+def test_health_pull_near_miss_masks_and_host_asserts():
+    """Algorithmic masks (the ops/matching.py / ops/roi_align.py shape)
+    consume the elementwise probe without reducing it to a health
+    signal; host-side assertions are not trace-reachable. Neither
+    flags."""
+    findings = lint("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def masked(x):
+            guarded = jnp.where(jnp.isfinite(x), x, 0.0)
+            bid = x * jnp.isfinite(x)
+            return guarded + bid
+
+        def host_gate(result):
+            assert np.isfinite(result).all()
+            return float(np.isnan(result).sum())
+    """)
+    assert "health-host-pull" not in rules_of(findings)
+
+
+def test_health_pull_sanctioned_in_train_health():
+    """train/health.py is THE home of in-graph health reductions — the
+    exact flagged shape is legal there."""
+    import textwrap
+
+    from mx_rcnn_tpu.analysis import Settings, lint_source
+
+    findings = lint_source(textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def finite_stats(x):
+            return jnp.sum(jnp.isfinite(x))
+    """), "mx_rcnn_tpu/train/health.py", Settings(), ALL_RULES)
+    assert "health-host-pull" not in rules_of(findings)

@@ -576,12 +576,23 @@ def test_fit_detector_obs_enabled_and_report(tmp_path):
     obs_dir = tmp_path / "obsrun"
     params = _tiny_fit(tmp_path, "ckpt",
                        **{"obs.enabled": True, "obs.dir": str(obs_dir),
-                          "obs.trace_at_step": 2, "obs.trace_steps": 1})
+                          "obs.trace_at_step": 2, "obs.trace_steps": 1,
+                          "obs.health_every": 2})
     assert params is not None
     events = report.load_events(str(obs_dir))
     types = {e["type"] for e in events}
     assert {"run_meta", "step", "epoch", "checkpoint", "cost",
-            "trace"} <= types
+            "trace", "health"} <= types
+
+    # graftpulse rides the same fit: a health reading every 2nd dispatch
+    # (4 dispatches -> 2), clean — all-zero nonfinite counts, finite
+    # norms, no anomaly
+    health = [e for e in events if e["type"] == "health"]
+    assert [e["dispatch"] for e in health] == [2, 4]
+    for e in health:
+        assert all(v == 0 for v in e["nonfinite"].values())
+        assert e["grad_norm"] > 0
+    assert not [e for e in events if e["type"] == "anomaly"]
 
     # graftprof: one cost event for the single shape bucket, with real
     # XLA numbers behind the computed MFU
@@ -629,6 +640,12 @@ def test_fit_detector_obs_enabled_and_report(tmp_path):
     assert blob["detail"]["epochs"] == 1
     assert blob["detail"]["checkpoints"] == 1
     assert blob["stall_count"] == 0
+    # graftpulse + env-fingerprint fields ride the bench blob into the
+    # perf ledger (anomaly accounting, environment-drift attribution)
+    assert blob["anomaly_count"] == 0 and blob["health_checks"] == 2
+    assert blob["detail"]["health"]["last"]["grad_norm"] > 0
+    assert blob["jax_version"] and blob["jaxlib_version"]
+    assert isinstance(blob["git_dirty"], bool)
     # graftprof: the folded blob carries the computed-cost fields the
     # perf ledger gates (MFU rounds to 0.0 at CPU step times — present,
     # not None, is the contract here)

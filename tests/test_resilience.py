@@ -549,16 +549,16 @@ def _parity(tmp_path, monkeypatch, flat, compute="f32", params_u=None):
 
 
 @pytest.mark.compile_heavy
-def test_kill_resume_parity_tree(tmp_path, monkeypatch):
-    _parity(tmp_path, monkeypatch, flat=False)
+def test_kill_resume_parity_tree(tmp_path, monkeypatch, tree_f32_baseline):
+    _parity(tmp_path, monkeypatch, flat=False, params_u=tree_f32_baseline)
 
 
 @pytest.mark.compile_heavy
-def test_kill_resume_parity_flat(tmp_path, monkeypatch):
+def test_kill_resume_parity_flat(tmp_path, monkeypatch, flat_f32_baseline):
     """The PR 4 checkpoint-interchange claim under interruption: the
     emergency save is TREE-form even from flat buffers, and the resumed
     flat run still matches uninterrupted bit for bit."""
-    _parity(tmp_path, monkeypatch, flat=True)
+    _parity(tmp_path, monkeypatch, flat=True, params_u=flat_f32_baseline)
 
 
 @pytest.mark.compile_heavy
