@@ -18,6 +18,7 @@ from mx_rcnn_tpu.analysis.rules import (
     retry,
     shapes,
     time_in_jit,
+    unbarriered_publish,
 )
 
 ALL_RULES = (
@@ -34,6 +35,7 @@ ALL_RULES = (
     chaos_site,
     dtype_cast,
     health_pull,
+    unbarriered_publish,
 )
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,116 @@
+"""unbarriered-publish: primary-only checkpoint publication needs a
+preceding all-host barrier.
+
+The multi-host save pattern is ``if is_primary(): save_checkpoint(...)``
+— one host publishes for the fleet. Without a barrier in front of it,
+the primary can publish a checkpoint cut at a boundary some peer never
+reached (it was still dispatching, or it died mid-drain): the save LOOKS
+complete but encodes a state the fleet never collectively held, and a
+``--resume auto`` restart silently rewinds the stragglers' progress —
+or, under graftquorum's torn-save detection, records a host set the meta
+sidecar cannot vouch for. graftquorum's contract (resilience/quorum.py)
+is barrier-then-publish: ``quorum.barrier(...)`` first, so the emergency
+and epoch-boundary saves in tools/train.py are cut only after every
+active host arrived.
+
+Recognized publication calls (syntactic): a call whose final name
+segment is ``save_checkpoint``, lexically inside the body of an ``if``
+whose test mentions the primary guard — a call to ``is_primary`` or a
+``process_index() == 0`` comparison. The rule is satisfied when a
+barrier call (final segment ``barrier`` — ``quorum.barrier``,
+``q.barrier``) appears EARLIER (lexically) in the same enclosing
+function. Known limitation, on purpose: an early-return guard
+(``if not is_primary(): return`` followed by the save) is not matched —
+the rule targets the repo's guarded-body idiom, where the reviewer can
+see guard and publication as one unit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import dotted_name
+
+NAME = "unbarriered-publish"
+RATIONALE = ("a primary-only checkpoint publication without a preceding "
+             "all-host barrier can persist a state some peer never "
+             "reached; barrier first (resilience/quorum.py), then let "
+             "process 0 publish")
+
+#: publication entry points (final dotted segment)
+_PUBLISH_NAMES = frozenset({"save_checkpoint"})
+
+
+def _final_segment(func: ast.expr) -> Optional[str]:
+    name = dotted_name(func)
+    if not name:
+        return None
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_primary_guard(test: ast.expr) -> bool:
+    """Does this if-test gate on being the primary/zeroth process?"""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            seg = _final_segment(node.func)
+            if seg == "is_primary":
+                return True
+        if isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+            has_pi = any(
+                isinstance(op, ast.Call)
+                and _final_segment(op.func) == "process_index"
+                for op in operands)
+            has_zero = any(
+                isinstance(op, ast.Constant) and op.value == 0
+                for op in operands)
+            if has_pi and has_zero:
+                return True
+    return False
+
+
+def _calls_by_line(func: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, final segment) of every call in the function, including
+    nested defs — a barrier factored into a helper closure still counts,
+    as long as it is defined before the publication site."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            seg = _final_segment(node.func)
+            if seg:
+                out.append((node.lineno, seg))
+    return out
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    seen = set()  # ast.walk visits nested defs from every enclosing scope
+    for func in ast.walk(ctx.tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = _calls_by_line(func)
+        barrier_lines = sorted(line for line, seg in calls
+                               if seg == "barrier")
+        for stmt in ast.walk(func):
+            if not (isinstance(stmt, ast.If)
+                    and _is_primary_guard(stmt.test)):
+                continue
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                seg = _final_segment(node.func)
+                if seg not in _PUBLISH_NAMES:
+                    continue
+                if any(line < node.lineno for line in barrier_lines):
+                    continue
+                if (node.lineno, node.col_offset) in seen:
+                    continue
+                seen.add((node.lineno, node.col_offset))
+                yield ctx.finding(
+                    NAME, node,
+                    f"{seg}() under a primary-only guard with no "
+                    "preceding all-host barrier in "
+                    f"`{func.name}` — a peer still dispatching (or dead) "
+                    "makes this a torn publication; call "
+                    "quorum.barrier(...) first (resilience/quorum.py)")

@@ -482,6 +482,35 @@ class ResilienceConfig:
     # injection); on real hardware with donated buffers the snapshot is
     # what bounds the deterministic replay after a mid-step loss.
     heal_snapshot_dispatches: int = 200
+    # graftquorum (resilience/quorum.py): multi-host coordination for
+    # preemption and heal. Deadline on every barrier / agree wait — a
+    # host that misses it is excluded from the round (and exits
+    # resumable when it discovers the sealed quorum moved on without
+    # it).
+    quorum_timeout_s: float = 60.0
+    # A heal quorum below this fraction of the host set aborts the run
+    # instead of limping on (half a fleet re-healing every few minutes
+    # is an outage, not elasticity).
+    quorum_min_fraction: float = 0.5
+    # Filesystem-backed KV store directory for the quorum protocol.
+    # "" = use jax.distributed's coordination-service KV client (real
+    # pods); a path = FileKVStore rooted there (the N-process CPU
+    # tests, or any fleet sharing a filesystem). Single-process runs
+    # never construct a quorum.
+    quorum_store_dir: str = ""
+    # Elastic phase 2 policy when a heal re-acquires a different device
+    # count (parallel/partition.py elastic_mesh_spec):
+    #   "shrink"  — phase 1 behavior: shrink the data axis to the
+    #               largest micro-batch divisor; never grow past the
+    #               nominal footprint.
+    #   "grow"    — shrink, plus GROW onto devices beyond the nominal
+    #               footprint when the re-acquire returns more.
+    #   "rescale" — grow, and on shrinks too deep to hold the global
+    #               batch keep rows-per-device constant instead: the
+    #               global batch scales with the fleet and the LR
+    #               schedule position is rebased in images-seen terms
+    #               via rebase_schedule_count.
+    elastic_mode: str = "shrink"
 
 
 @dataclass(frozen=True)

@@ -80,9 +80,13 @@ def obs_from_config(cfg, default_dir: str = ""):
             "obs.enabled=true needs obs.dir (or a caller-provided run "
             "directory) to place events.jsonl")
     try:
-        import jax
+        # Coordination identity, not raw jax: under the graftquorum
+        # simulated-host tests each CPU process stamps (and names its
+        # JSONL after) the host index it is standing in for, so the
+        # report's per-host fold sees the fleet it would see on a pod.
+        from mx_rcnn_tpu.parallel.distributed import process_index as _pi
 
-        process_index = jax.process_index()
+        process_index = _pi()
     except (ImportError, RuntimeError):
         process_index = 0
     return open_event_log(directory, process_index=process_index,

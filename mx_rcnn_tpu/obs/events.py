@@ -70,6 +70,11 @@ EVENT_TYPES = (
                    # tripwire (nonfinite, grad explosion, loss z-score)
                    # — reasons, emergency-checkpoint path, flight-dump
                    # path (obs/health.py)
+    "quorum",      # graftquorum: one coordination round — kind
+                   # (preempt/heal/excluded), hosts arrived/excluded,
+                   # agreed boundary or topology (resilience/quorum.py
+                   # via tools/train.py; the process stamp says which
+                   # host's view this record is)
 )
 
 #: Buffered kinds — everything else flushes to disk immediately, so the

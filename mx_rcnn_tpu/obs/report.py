@@ -43,11 +43,25 @@ def load_jsonl_tolerant(path: str, hint: str = "run") -> List[Dict[str, Any]]:
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
-    """Parse one JSONL event file (or a run dir holding events.jsonl),
-    tolerating a torn tail line (load_jsonl_tolerant)."""
-    if os.path.isdir(path):
-        path = os.path.join(path, "events.jsonl")
-    return load_jsonl_tolerant(path, hint="run")
+    """Parse one JSONL event file, or a run dir — folding EVERY per-host
+    stream it holds (``events.jsonl`` = host 0, ``events.<i>.jsonl`` =
+    the others; obs/events.py::event_log_path) into one list ordered by
+    wall time, so a multi-host run's quorum/heal/preempt records
+    interleave the way the fleet experienced them. Each record already
+    carries its ``process`` stamp. Tolerates a torn tail line per stream
+    (load_jsonl_tolerant)."""
+    if not os.path.isdir(path):
+        return load_jsonl_tolerant(path, hint="run")
+    streams = sorted(
+        name for name in os.listdir(path)
+        if name == "events.jsonl"
+        or (name.startswith("events.") and name.endswith(".jsonl")))
+    records: List[Dict[str, Any]] = []
+    for name in streams:
+        records.extend(load_jsonl_tolerant(os.path.join(path, name),
+                                           hint="run"))
+    records.sort(key=lambda e: e.get("t_wall", 0.0))
+    return records
 
 
 def _percentile(sorted_vals: List[float], pct: float) -> float:
@@ -239,6 +253,19 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "last_error": (by_type["heal"][-1].get("error")
                            if by_type.get("heal") else None),
         },
+        # graftquorum: multi-host coordination rounds — per-host records
+        # interleaved by load_events, so `hosts` is how many distinct
+        # process stamps the fold saw and `excluded` collects every host
+        # any round sealed out (the "who got dropped" runbook line).
+        "quorum": {
+            "rounds": len(by_type.get("quorum", ())),
+            "hosts": len({e.get("process", 0) for e in events}),
+            "excluded": sorted({h for e in by_type.get("quorum", ())
+                                for h in (e.get("excluded") or ())}),
+            "last": ({k: by_type["quorum"][-1].get(k) for k in
+                      ("kind", "hosts", "excluded", "agreed", "spec")}
+                     if by_type.get("quorum") else None),
+        },
         "crash": ({"error": crash.get("error"), "step": crash.get("step")}
                   if crash else None),
     }
@@ -351,6 +378,15 @@ def render(summary: Dict[str, Any]) -> str:
             f"  heal:       {he['count']} in-run recover(ies), "
             f"{he['downtime_s']:.0f}s down{shrink} | last: "
             f"{he['last_error']}")
+    qu = summary.get("quorum", {})
+    if qu.get("rounds"):
+        last = qu.get("last") or {}
+        excl = (f", excluded hosts {qu['excluded']}" if qu.get("excluded")
+                else "")
+        lines.append(
+            f"  quorum:     {qu['rounds']} coordination round(s) across "
+            f"{qu['hosts']} host stream(s){excl} | last: "
+            f"kind={last.get('kind')} hosts={last.get('hosts')}")
     for name, row in summary["bench"].items():
         lines.append(f"  bench:      {name}: {row}")
     if summary["crash"]:

@@ -62,9 +62,38 @@ def maybe_initialize_distributed(
     return False
 
 
+def process_index() -> int:
+    """This host's coordination identity.
+
+    Normally ``jax.process_index()``. Under the graftquorum CPU tests the
+    MXRCNN_SIM_* pair overrides it: N separate single-process CPU runs
+    each execute the FULL replicated computation (identical deterministic
+    trajectories — no collectives cross the processes) while believing
+    they are host i of N for everything COORDINATION-shaped: quorum
+    membership, barrier arrival, who publishes checkpoints, the process
+    stamp on obs events. Data sharding deliberately keeps using the raw
+    jax calls (each simulated host must load the full global batch to
+    stay bit-identical), so the override lives here and not in
+    local_data_shards/make_global_batch.
+    """
+    sim = os.environ.get("MXRCNN_SIM_PROCESS_ID")
+    if sim is not None:
+        return int(sim)
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """World size for coordination (see process_index for the simulated-
+    host override contract)."""
+    sim = os.environ.get("MXRCNN_SIM_NUM_PROCESSES")
+    if sim is not None:
+        return int(sim)
+    return jax.process_count()
+
+
 def is_primary() -> bool:
     """True on the process that owns logging/checkpoint writes."""
-    return jax.process_index() == 0
+    return process_index() == 0
 
 
 def local_data_shards(mesh) -> int:

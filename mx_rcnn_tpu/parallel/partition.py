@@ -108,24 +108,57 @@ def flat_segment_specs(params, specs):
 
 
 def elastic_mesh_spec(data: int, model: int, n_devices: int,
-                      micro_batch: int) -> str:
+                      micro_batch: int, mode: str = "shrink") -> str:
     """Re-derive a mesh spec when the backend comes back with a different
-    device count (graftheal shrink / elastic resume).
+    device count (graftheal shrink / elastic resume / elastic phase 2).
 
-    The contract is GLOBAL-BATCH INVARIANCE: the run's hyperparameters
-    (batch, LR schedule, epoch order) describe the run, not the hardware,
-    so a (data, model) mesh re-cut onto fewer devices keeps the model
-    axis intact (a TP/PP-sharded weight cannot change its partition count
-    mid-run without a resharding story) and shrinks the DATA axis to the
-    largest size that still divides ``micro_batch`` (the per-micro-step
-    global image count) — each surviving device simply carries more batch
-    rows, and the loss trajectory continues up to psum reassociation.
-    With ``n_devices`` at or above the original footprint the original
-    shape is kept (extra devices idle; growth is a scheduling decision,
-    not a recovery).
+    The default contract is GLOBAL-BATCH INVARIANCE: the run's
+    hyperparameters (batch, LR schedule, epoch order) describe the run,
+    not the hardware, so a (data, model) mesh re-cut onto fewer devices
+    keeps the model axis intact (a TP/PP-sharded weight cannot change
+    its partition count mid-run without a resharding story) and shrinks
+    the DATA axis to the largest size that still divides ``micro_batch``
+    (the per-micro-step global image count) — each surviving device
+    simply carries more batch rows, and the loss trajectory continues up
+    to psum reassociation.
+
+    ``mode`` is elastic phase 2 (``resilience.elastic_mode``):
+
+    - ``"shrink"`` — the phase 1 behavior above; with ``n_devices`` at
+      or above the original footprint the original shape is kept (extra
+      devices idle; growth stays a scheduling decision).
+    - ``"grow"`` — additionally GROW the data axis onto devices beyond
+      the nominal footprint when the re-acquire returns more, to the
+      largest micro-batch divisor that fits (still batch-invariant:
+      each device carries FEWER rows).
+    - ``"rescale"`` — grow, and when a shrink cannot hold the global
+      batch the caller rescales it instead: the data axis takes ALL
+      available slots (no divisor constraint) and the caller keeps
+      rows-per-device constant, shrinking the global batch and rebasing
+      the LR schedule in images-seen terms (rebase_schedule_count).
+      This function only picks the axis size; the batch/schedule math
+      lives in the trainer.
     """
+    if mode not in ("shrink", "grow", "rescale"):
+        raise ValueError(f"unknown elastic mode {mode!r}; expected "
+                         "shrink | grow | rescale")
     if n_devices >= data * model:
-        return f"{data}x{model}"
+        if mode == "shrink":
+            grown = data
+        else:
+            # GROW: the largest micro-batch divisor the returned devices
+            # can seat (>= the nominal data axis; falls back to nominal
+            # when no larger divisor fits).
+            avail = n_devices // model
+            grown = next((k for k in range(avail, data, -1)
+                          if micro_batch % k == 0), data)
+        if grown != data:
+            logger.warning(
+                "elastic mesh: backend returned %d device(s) above the "
+                "%dx%d footprint; growing data axis %d -> %d "
+                "(global micro-batch %d invariant, fewer rows per device)",
+                n_devices, data, model, data, grown, micro_batch)
+        return f"{grown}x{model}"
     if n_devices < model:
         raise ValueError(
             f"backend came back with {n_devices} device(s), fewer than the "
@@ -133,6 +166,15 @@ def elastic_mesh_spec(data: int, model: int, n_devices: int,
             "below one data shard; resume from checkpoint on a matching "
             "topology instead")
     avail = n_devices // model
+    if mode == "rescale" and micro_batch % avail:
+        # Too deep for a batch-invariant shrink: take every slot and let
+        # the trainer rescale the global batch instead of idling devices.
+        logger.warning(
+            "elastic mesh: %dx%d does not fit %d device(s) and %d does "
+            "not divide the micro-batch %d; rescale mode takes all %d "
+            "data slots (rows-per-device constant, global batch scales)",
+            data, model, n_devices, avail, micro_batch, avail)
+        return f"{avail}x{model}"
     new_data = next(k for k in range(min(avail, data), 0, -1)
                     if micro_batch % k == 0)
     logger.warning(

@@ -23,9 +23,15 @@ hung compile can eat an entire bench timeout (BENCH_r05 rc=124). graftscope
   when the backend returns with fewer devices. No crash, no operator.
 - ``chaos``    — deterministic fault injection (raise UNAVAILABLE on the
   first N probes or mid-run at step K, SIGTERM at step K, hang one bench
-  config, SIGKILL at a named site, shrink the re-acquired device list)
-  so every guarantee above is exercised by tier-1 CPU tests instead of
-  by the next real outage.
+  config, SIGKILL at a named site, shrink the re-acquired device list,
+  kill one host of a simulated fleet, skip a quorum barrier) so every
+  guarantee above is exercised by tier-1 CPU tests instead of by the
+  next real outage.
+- ``quorum``   — graftquorum: multi-host coordination (deadline-bounded
+  barriers, propose/agree, generation-numbered heal rounds with
+  exclusion) over jax.distributed's KV client or a filesystem store, so
+  preemption commits ONE consistent fleet-wide save and a backend loss
+  heals in lockstep across hosts instead of deadlocking the survivors.
 
 Config: the ``resilience`` section of config.py; runbook: OUTAGES.md.
 """
@@ -45,6 +51,14 @@ from mx_rcnn_tpu.resilience.preempt import (
     PreemptionExit,
     PreemptionGuard,
 )
+from mx_rcnn_tpu.resilience.quorum import (
+    CoordinatedStop,
+    FileKVStore,
+    Quorum,
+    QuorumError,
+    QuorumExcludedError,
+    QuorumOutcome,
+)
 
 __all__ = [
     "BackendUnavailableError",
@@ -56,4 +70,10 @@ __all__ = [
     "RESUMABLE_RC",
     "PreemptionExit",
     "PreemptionGuard",
+    "CoordinatedStop",
+    "FileKVStore",
+    "Quorum",
+    "QuorumError",
+    "QuorumExcludedError",
+    "QuorumOutcome",
 ]

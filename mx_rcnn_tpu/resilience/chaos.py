@@ -57,6 +57,10 @@ SITES = frozenset({
     "grad_inject",           # train-step build: nan_at_step's IN-GRAPH
                              # gradient poisoning is traced in here
                              # (train/step.py; fires once, at build time)
+    "quorum_barrier",        # graftquorum barrier arrival: the
+                             # barrier_timeout_at injection makes THIS
+                             # host skip arriving (a hang past the
+                             # deadline), driving the exclusion path
 })
 
 #: Per-process injection state (e.g. how many backend probes have already
@@ -67,6 +71,18 @@ _counters: dict = {}
 def reset():
     """Clear injection state (tests re-arming a spec within one process)."""
     _counters.clear()
+
+
+def _host_index(environ=os.environ) -> int:
+    """This process's host index for per-host injections — the simulated
+    identity under test (MXRCNN_SIM_PROCESS_ID, parallel/distributed.py)
+    or the real distributed rank, 0 otherwise. Env-read keeps this
+    module stdlib-only (no jax import)."""
+    for var in ("MXRCNN_SIM_PROCESS_ID", "MXRCNN_PROCESS_ID"):
+        value = environ.get(var)
+        if value is not None:
+            return int(value)
+    return 0
 
 
 @dataclass(frozen=True)
@@ -106,6 +122,19 @@ class ChaosSpec:
     #: fires every time the traced step counter reaches K while armed —
     #: disarm (unset the env var) before a --resume auto continuation.
     nan_at_step: int = 0
+    #: Per-host death: ``H:K`` SIGKILLs the process whose host index is
+    #: H (simulated-host identity, parallel/distributed.py) once the
+    #: optimizer step count reaches K — the spot-reclaim-takes-a-whole-
+    #: host scenario the quorum exclusion path must survive. Fires at
+    #: the "train_dispatch" site; every other host parses the same spec
+    #: and no-ops.
+    host_die_at_step: str = ""
+    #: Make THIS host (optionally scoped ``H:site``) skip arriving at
+    #: the named barrier site — the others see a partial arrival set at
+    #: the deadline, which is the deterministic way to drive the
+    #: quorum exclusion / min-fraction paths. The only barrier site
+    #: today is "quorum_barrier".
+    barrier_timeout_at: str = ""
 
     @property
     def active(self) -> bool:
@@ -174,12 +203,38 @@ class ChaosSpec:
             return devices[:n]
         return devices
 
+    def maybe_host_die(self, step: int):
+        """SIGKILL this process when its host index matches an armed
+        ``host_die_at_step=H:K`` and the step count reaches K — one
+        whole simulated host drops out of the fleet, mid-run."""
+        if not self.host_die_at_step:
+            return
+        host, _, at = self.host_die_at_step.partition(":")
+        if (_host_index() == int(host) and step >= int(at)
+                and not _counters.get("host_die")):
+            _counters["host_die"] = 1
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_barrier_timeout(self, site_name: str) -> bool:
+        """True when this host should SKIP arriving at ``site_name`` —
+        the quorum barrier then sees a partial set at its deadline.
+        ``barrier_timeout_at`` is either a bare site (this host) or
+        ``H:site`` (only host index H skips)."""
+        armed = self.barrier_timeout_at
+        if not armed:
+            return False
+        host, sep, target = armed.partition(":")
+        if sep:
+            return _host_index() == int(host) and site_name == target
+        return site_name == armed
+
     def fire(self, name: str, step: int = 0, devices=None):
         """Dispatch one registered injection site on a PRE-PARSED spec
         (the hot train loop parses MX_RCNN_CHAOS once and calls this
         behind an ``active`` check). Returns ``devices`` — possibly
-        truncated — for value sites; None otherwise. Unregistered names
-        raise: see ``SITES``."""
+        truncated — for value sites, True from "quorum_barrier" when
+        the arrival should be skipped; None otherwise. Unregistered
+        names raise: see ``SITES``."""
         if name not in SITES:
             raise ValueError(
                 f"unregistered chaos site {name!r}; the registered sites "
@@ -189,9 +244,12 @@ class ChaosSpec:
         # re-open the armed-but-never-fires hole that check closes).
         self.maybe_die(name)
         if name == "train_dispatch":
+            self.maybe_host_die(step)
             self.maybe_device_loss(step)
         elif name == "backend_reacquire":
             devices = self.maybe_shrink(devices)
+        elif name == "quorum_barrier":
+            return self.maybe_barrier_timeout(name)
         return devices
 
 
@@ -231,6 +289,20 @@ def parse(text: str) -> ChaosSpec:
         raise ValueError(
             f"bad {ENV_VAR} die_at site {kw['die_at']!r}; registered "
             f"sites: {sorted(SITES)}")
+    if kw.get("host_die_at_step"):
+        host, sep, at = kw["host_die_at_step"].partition(":")
+        if not sep or not host.isdigit() or not at.isdigit():
+            raise ValueError(
+                f"bad {ENV_VAR} host_die_at_step "
+                f"{kw['host_die_at_step']!r}; expected H:K (host index, "
+                "step)")
+    if kw.get("barrier_timeout_at"):
+        _, sep, target = kw["barrier_timeout_at"].partition(":")
+        site_name = target if sep else kw["barrier_timeout_at"]
+        if site_name not in SITES:
+            raise ValueError(
+                f"bad {ENV_VAR} barrier_timeout_at site {site_name!r}; "
+                f"registered sites: {sorted(SITES)}")
     return ChaosSpec(**kw)
 
 

@@ -123,6 +123,17 @@ class Healer:
         self._since_snapshot = 0
         self._n_devices: Optional[int] = None
         self._footprint: Optional[int] = None
+        # graftquorum (resilience/quorum.py): multi-host runs install a
+        # hook called with the re-acquired device list; it runs one
+        # generation of the heal quorum (barrier, topology agreement,
+        # exclusion) and returns the QuorumOutcome — the agreed mesh
+        # spec the session rebuild must adopt. Raises QuorumExcludedError
+        # on the host the quorum moved on without. None = single-host
+        # behavior (the session derives the spec locally).
+        self.quorum_hook: Optional[Callable] = None
+        #: The last heal's QuorumOutcome (None single-host) — the session
+        #: rebuild reads the agreed spec from here.
+        self.outcome = None
 
     # -- bookkeeping the train loop drives ---------------------------------
 
@@ -210,6 +221,15 @@ class Healer:
         _clear_backend_cache()
         devices = acquire_backend(self.rcfg, elog=self.elog)
         devices = chaos.site("backend_reacquire", devices=devices)
+        # Multi-host: every surviving host reaches the heal quorum with
+        # its re-acquired capacity and adopts the agreed topology; a
+        # host that missed the deadline gets QuorumExcludedError here
+        # (propagates — the survivors sealed the round without it and
+        # its only correct move is a resumable exit). Inside the
+        # watchdog-paused window: a quorum wait is not a stall.
+        self.outcome = None
+        if self.quorum_hook is not None:
+            self.outcome = self.quorum_hook(devices)
         downtime = self._clock() - t0
         before = self._n_devices
         # The event's "after" is the recovered capacity CAPPED at the
@@ -229,11 +249,23 @@ class Healer:
             # post-heal step (re-acquire + fresh compile): cold grace.
             self.watchdog.reset()
         if self.elog is not None and self.elog.enabled:
+            quorum_fields = {}
+            if self.outcome is not None:
+                # The agreed round, folded into the heal record so the
+                # report can show WHO healed together and what topology
+                # they agreed on (the event also carries this process's
+                # index via the EventLog process stamp).
+                quorum_fields = dict(
+                    quorum_generation=self.outcome.generation,
+                    quorum_hosts=self.outcome.arrived,
+                    quorum_excluded=self.outcome.excluded,
+                    quorum_devices=self.outcome.devices,
+                    quorum_spec=self.outcome.spec)
             self.elog.emit("heal", epoch=carry.epoch, dispatch=carry.dispatch,
                            error=str(exc)[:500], mode=mode,
                            downtime_s=round(downtime, 3),
                            devices_before=before,
-                           devices_after=after)
+                           devices_after=after, **quorum_fields)
         if self.recorder is not None:
             self.recorder.dump("heal")
         logger.warning(

@@ -34,9 +34,12 @@ class PreemptionExit(SystemExit):
     so a plain CLI run exits with the resumable rc with no extra wiring,
     while library callers (tests) can still catch it."""
 
-    def __init__(self, signum: int = signal.SIGTERM):
+    def __init__(self, signum: Optional[int] = signal.SIGTERM):
+        # None = no local signal: this host exits resumably because the
+        # FLEET is stopping (coordinated preemption / quorum exclusion —
+        # resilience/quorum.py), not because it was signaled itself.
         super().__init__(RESUMABLE_RC)
-        self.signum = int(signum)
+        self.signum = int(signum) if signum is not None else None
 
 
 class PreemptionGuard:

@@ -33,14 +33,19 @@ from mx_rcnn_tpu.obs.costs import CostTracker
 from mx_rcnn_tpu.obs.profile import TraceController
 from mx_rcnn_tpu.parallel.mesh import create_mesh, shard_batch
 from mx_rcnn_tpu.resilience import (
+    CoordinatedStop,
+    FileKVStore,
     HealCarry,
     Healer,
     PreemptionExit,
     PreemptionGuard,
+    Quorum,
+    QuorumExcludedError,
     acquire_backend,
     host_tree_copy,
 )
 from mx_rcnn_tpu.resilience import chaos
+from mx_rcnn_tpu.resilience import quorum as quorum_lib
 from mx_rcnn_tpu.train.callback import Speedometer
 from mx_rcnn_tpu.train.checkpoint import (
     checkpoint_meta,
@@ -156,7 +161,12 @@ def fit_detector(
     dropping it — callbacks should tolerate a rare re-invocation for
     the same epoch.
     """
-    from mx_rcnn_tpu.parallel.distributed import is_primary, local_data_shards
+    from mx_rcnn_tpu.parallel.distributed import (
+        is_primary,
+        local_data_shards,
+        process_count,
+        process_index,
+    )
     from mx_rcnn_tpu.train import precision
 
     # graftcast: resolve (and validate, loudly, before any device work)
@@ -245,27 +255,35 @@ def fit_detector(
 
         validate_canvas_pack(loader_cfg)
 
-    if loader_factory is None:
-        loader = AnchorLoader(roidb, loader_cfg, num_shards=n_local,
-                              seed=seed,
-                              process_count=jax.process_count(),
-                              process_index=jax.process_index())
-    else:
+    def _build_loader(n_shards: int):
+        """Loader for ``n_shards`` data shards. Factored out because the
+        session loop rebuilds it under ``resilience.elastic_mode=rescale``
+        (the global batch scales with the surviving fleet). Data sharding
+        stays on RAW ``jax.process_count``/``process_index`` on purpose:
+        the graftquorum simulated hosts override coordination identity
+        only, and each sim process must load the full global batch to
+        keep trajectories bit-identical (parallel/distributed.py)."""
+        if loader_factory is None:
+            return AnchorLoader(roidb, loader_cfg, num_shards=n_shards,
+                                seed=seed,
+                                process_count=jax.process_count(),
+                                process_index=jax.process_index())
         import inspect
 
         params_of = inspect.signature(loader_factory).parameters
         if "process_count" in params_of or any(
                 p.kind is inspect.Parameter.VAR_KEYWORD
                 for p in params_of.values()):
-            loader = loader_factory(roidb, loader_cfg, n_local,
-                                    process_count=jax.process_count(),
-                                    process_index=jax.process_index())
-        else:
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "loader_factory must accept process_count/process_index "
-                    "kwargs to run multi-host")
-            loader = loader_factory(roidb, loader_cfg, n_local)
+            return loader_factory(roidb, loader_cfg, n_shards,
+                                  process_count=jax.process_count(),
+                                  process_index=jax.process_index())
+        if jax.process_count() > 1:
+            raise ValueError(
+                "loader_factory must accept process_count/process_index "
+                "kwargs to run multi-host")
+        return loader_factory(roidb, loader_cfg, n_shards)
+
+    loader = _build_loader(n_local)
     steps_per_epoch = max(len(loader), 1)
 
     # Global images per dispatch — the run's INVARIANT unit of progress.
@@ -392,11 +410,60 @@ def fit_detector(
                       else None)
     speedometer = Speedometer(ipd, frequent, event_log=obs_log)
 
+    # graftquorum (resilience/quorum.py): the coordination layer every
+    # multi-host resilience path below rides. A preempted fleet drains to
+    # ONE agreed dispatch boundary before the leader publishes; a healed
+    # fleet agrees the post-heal topology and rebuilds in lockstep. The
+    # store is jax.distributed's KV service on a real pod, or a shared
+    # filesystem directory (resilience.quorum_store_dir) — which is also
+    # how the N-process CPU tests exercise the real protocol.
+    n_hosts = process_count()
+    quorum = stopper = None
+    if n_hosts > 1:
+        if cfg.resilience.quorum_store_dir:
+            store = FileKVStore(cfg.resilience.quorum_store_dir)
+        else:
+            client = quorum_lib.jax_kv_client()
+            store = (quorum_lib.JaxKVStore(client)
+                     if client is not None else None)
+        if store is None:
+            logger.warning(
+                "graftquorum: no KV store reachable (jax.distributed not "
+                "initialized and resilience.quorum_store_dir unset) — "
+                "multi-host coordination disabled; preemption and heal "
+                "fall back to uncoordinated per-host behavior")
+        else:
+            quorum = Quorum(
+                store, process_index(), n_hosts,
+                timeout_s=cfg.resilience.quorum_timeout_s,
+                min_fraction=cfg.resilience.quorum_min_fraction)
+            stopper = CoordinatedStop(quorum)
+            logger.info(
+                "graftquorum: host %d/%d coordinating via %s",
+                process_index(), n_hosts,
+                "filesystem store" if cfg.resilience.quorum_store_dir
+                else "jax.distributed KV client")
+
     # Async epoch-end saves (train/checkpoint.py CheckpointWriter); the
     # multi-host primary-only pattern needs the synchronous path (orbax's
     # cross-process commit barrier would hang with one caller).
     writer = None
-    if cfg.train.async_checkpoint and jax.process_count() == 1:
+    if cfg.train.async_checkpoint and n_hosts > 1:
+        # LOUD fallback (graftquorum satellite): silently dropping the
+        # requested async writer made multi-host epoch ends mysteriously
+        # slower than single-host. One structured `checkpoint` record
+        # with fallback="sync" says what happened and why.
+        logger.warning(
+            "train.async_checkpoint requested but process_count()=%d: "
+            "falling back to SYNCHRONOUS epoch saves (the async writer "
+            "cannot satisfy orbax's cross-process commit barrier under "
+            "the primary-only save pattern)", n_hosts)
+        if obs_log.enabled:
+            obs_log.emit("checkpoint", fallback="sync",
+                         reason="multi-host: async writer incompatible "
+                                "with primary-only saves",
+                         hosts=n_hosts)
+    elif cfg.train.async_checkpoint:
         from mx_rcnn_tpu.train import flatcore as _flatcore
 
         if (_flatcore.flat_mode_for(cfg)
@@ -435,15 +502,15 @@ def fit_detector(
     # fallback is a host-owned copy of the starting state, refreshed by
     # periodic snapshots and by every successful capture.
     healer = None
-    if cfg.resilience.heal and jax.process_count() > 1:
-        # Multi-host heal needs coordination this PR does not have: one
-        # process tearing its backend down mid-collective would wedge
-        # the others, and the post-heal topology must be agreed across
-        # hosts (the ROADMAP multi-host item). Stay inert — preemption +
-        # --resume auto still covers the fleet case.
-        logger.warning("resilience.heal is single-process only for now; "
-                       "disabled under jax.process_count()=%d",
-                       jax.process_count())
+    if cfg.resilience.heal and n_hosts > 1 and quorum is None:
+        # Multi-host heal NEEDS the quorum: one process tearing its
+        # backend down mid-collective wedges the others unless every
+        # survivor re-converges on an agreed post-heal topology. Without
+        # a reachable KV store, stay inert — preemption + --resume auto
+        # still covers the fleet case.
+        logger.warning("resilience.heal under process_count()=%d needs "
+                       "graftquorum coordination but no KV store is "
+                       "reachable; heal disabled", n_hosts)
     elif cfg.resilience.heal:
         healer = Healer(cfg.resilience, elog=obs_log, watchdog=watchdog,
                         recorder=recorder)
@@ -451,22 +518,65 @@ def fit_detector(
             params=host_tree_copy(carry.params),
             opt_state=host_tree_copy(carry.opt_state),
             epoch=carry.epoch, dispatch=carry.dispatch))
+        if quorum is not None:
+            from mx_rcnn_tpu.parallel.partition import elastic_mesh_spec
+
+            heal_generation = itertools.count()
+
+            def _heal_quorum(devices):
+                """graftquorum heal round, run INSIDE Healer.recover
+                right after this host re-acquired its backend: survivors
+                rendezvous under the deadline, the leader seals the
+                post-heal topology from the MINIMUM surviving capacity,
+                and a host that misses the round is excluded (it raises
+                QuorumExcludedError out of recover — caught below and
+                turned into a resumable exit)."""
+                outcome = quorum.heal_round(
+                    next(heal_generation), len(devices),
+                    lambda n_dev, n_arrived: elastic_mesh_spec(
+                        d0, m0, n_dev, cfg.train.batch_images * n_data,
+                        mode=cfg.resilience.elastic_mode))
+                if obs_log.enabled:
+                    obs_log.emit("quorum", kind="heal",
+                                 generation=outcome.generation,
+                                 hosts=sorted(outcome.arrived),
+                                 excluded=sorted(outcome.excluded),
+                                 devices=outcome.devices,
+                                 spec=outcome.spec)
+                return outcome
+
+            healer.quorum_hook = _heal_quorum
 
     # Per-session device-facing objects, (re)assigned by the session loop
     # below; declared here so the closures and the return path see them.
     state = flat_core = bag = None
     pos = (carry.epoch, carry.dispatch)
+    # Coordinated-stop latch: this host has published its preemption
+    # request to the quorum (at most one request per run — the agreed
+    # boundary is cached by CoordinatedStop.check thereafter).
+    stop_requested = False
 
-    def _ckpt_meta(at_epoch: int, at_dispatch: Optional[int]):
+    def _ckpt_meta(at_epoch: int, at_dispatch: Optional[int],
+                   hosts=None):
         """The topology sidecar (train/checkpoint.py::META_NAME): what a
         dispatch WAS when this checkpoint was cut, so an elastic resume
-        can convert the tag (see the skip recompute above)."""
-        return {"epoch": at_epoch, "dispatch": at_dispatch,
+        can convert the tag (see the skip recompute above). Multi-host
+        runs also record the PARTICIPATING host set against the expected
+        count — latest_checkpoint refuses an emergency save whose host
+        set is incomplete (a torn save: some host died before reaching
+        the publication barrier)."""
+        meta = {"epoch": at_epoch, "dispatch": at_dispatch,
                 "images_per_dispatch": ipd,
                 "steps_per_epoch": steps_per_epoch,
                 "device_count": int(mesh.devices.size),
                 "mesh": {a: int(s) for a, s in
                          zip(mesh.axis_names, mesh.devices.shape)}}
+        if n_hosts > 1:
+            active = (quorum.active if quorum is not None
+                      else range(n_hosts))
+            meta["host_count"] = len(tuple(active))
+            meta["hosts"] = sorted(hosts if hosts is not None else active)
+        return meta
 
     def _capture() -> HealCarry:
         """graftheal's in-memory emergency capture: the live train state
@@ -498,7 +608,22 @@ def fit_detector(
         """Orderly preemption exit: emergency checkpoint (sync — it must
         be durable before the process dies), `preempt` event, then
         PreemptionExit carrying the resumable rc. at_dispatch=None marks
-        an epoch boundary (at_epoch epochs complete)."""
+        an epoch boundary (at_epoch epochs complete).
+
+        Multi-host (graftquorum): every host drained to the agreed stop
+        boundary before getting here, and the fleet BARRIERS before the
+        leader publishes — so the one emergency save is cut from a state
+        every participant reached, and its meta records exactly who was
+        still alive (`hosts`). A host missing from that set marks the
+        save torn; latest_checkpoint skips it on resume."""
+        arrived = None
+        if quorum is not None:
+            arrived = quorum.barrier("preempt/stop")
+            if obs_log.enabled:
+                obs_log.emit("quorum", kind="preempt",
+                             hosts=sorted(arrived),
+                             excluded=sorted(quorum.active - arrived),
+                             agreed=[at_epoch, at_dispatch])
         saved = None
         if need_save and cfg.resilience.preempt_save and is_primary():
             if flat_core is not None:
@@ -509,9 +634,12 @@ def fit_detector(
                 prefix, at_epoch, save_params, save_opt,
                 means=cfg.train.bbox_means, stds=cfg.train.bbox_stds,
                 num_classes=cfg.dataset.num_classes, dispatch=at_dispatch,
-                meta=_ckpt_meta(at_epoch, at_dispatch))
+                meta=_ckpt_meta(at_epoch, at_dispatch, hosts=arrived))
+        # signum is None on a host that was never signaled itself but is
+        # draining to the fleet's agreed boundary (coordinated stop).
+        signum = guard.signum if guard is not None else None
         if obs_log.enabled:
-            obs_log.emit("preempt", signal=guard.signum,
+            obs_log.emit("preempt", signal=signum,
                          step=(at_epoch * steps_per_epoch
                                + (at_dispatch or 0) * multi),
                          saved=saved)
@@ -519,9 +647,9 @@ def fit_detector(
             recorder.dump("preempt")
         logger.warning("preempted (signal %s) at epoch %d dispatch %s — "
                        "exiting rc %d; restart with --resume auto",
-                       guard.signum, at_epoch, at_dispatch,
+                       signum, at_epoch, at_dispatch,
                        PreemptionExit().code)
-        raise PreemptionExit(guard.signum)
+        raise PreemptionExit(signum)
 
     # graftpulse (obs/health.py + train/health.py): with obs on and
     # obs.health_every > 0 the step returns an extra in-graph numerics
@@ -572,15 +700,25 @@ def fit_detector(
                     if healer.devices is not None:
                         # Re-acquired backend, possibly smaller: re-cut
                         # the mesh (model axis kept, data axis re-derived
-                        # — global batch invariant, so the loader and the
-                        # schedule carry straight across) and re-derive
-                        # everything device-facing against it.
+                        # — global batch invariant under the default
+                        # shrink mode, so the loader and the schedule
+                        # carry straight across) and re-derive everything
+                        # device-facing against it.
                         from mx_rcnn_tpu.parallel.partition import (
                             elastic_mesh_spec)
 
-                        respec = elastic_mesh_spec(
-                            d0, m0, len(healer.devices),
-                            cfg.train.batch_images * n_data)
+                        if healer.outcome is not None:
+                            # graftquorum: adopt the AGREED topology —
+                            # every surviving host rebuilds in lockstep
+                            # on the spec the heal round sealed (derived
+                            # from the MINIMUM re-acquired capacity
+                            # across the quorum), not its own local view.
+                            respec = healer.outcome.spec
+                        else:
+                            respec = elastic_mesh_spec(
+                                d0, m0, len(healer.devices),
+                                cfg.train.batch_images * n_data,
+                                mode=cfg.resilience.elastic_mode)
                         mesh = create_mesh(respec, devices=healer.devices)
                         model = build_model(cfg, mesh=mesh)
                         logger.info(
@@ -589,6 +727,45 @@ def fit_detector(
                                 mesh.axis_names,
                                 (int(s) for s in mesh.devices.shape))),
                             int(mesh.devices.size))
+                        if (cfg.resilience.elastic_mode == "rescale"
+                                and (cfg.train.batch_images * n_data)
+                                % mesh.shape["data"]):
+                            # RESCALE (elastic phase 2): the agreed data
+                            # axis cannot carry the nominal global batch
+                            # (not a divisor) — too-deep shrink or odd
+                            # grow. Keep rows-per-device constant and let
+                            # the GLOBAL batch scale with the fleet:
+                            # rebuild the loader for the new shard count,
+                            # re-derive the progress units, and rebase
+                            # the carry position + schedule counters
+                            # through the invariant (images consumed).
+                            new_data = mesh.shape["data"]
+                            old_ipd_live = ipd
+                            if hasattr(loader, "close"):
+                                loader.close()
+                            n_local = local_data_shards(mesh)
+                            loader = _build_loader(n_local)
+                            steps_per_epoch = max(len(loader), 1)
+                            ipd = (cfg.train.batch_images * accum
+                                   * new_data * multi)
+                            disp_per_epoch = max(1,
+                                                 steps_per_epoch // multi)
+                            images_done = carry.dispatch * old_ipd_live
+                            carry.dispatch = images_done // ipd
+                            if carry.opt_state is not None:
+                                carry.opt_state = rebase_schedule_count(
+                                    carry.opt_state,
+                                    carry.epoch * steps_per_epoch
+                                    + carry.dispatch * multi)
+                            logger.warning(
+                                "elastic rescale: global batch now %d "
+                                "image(s)/dispatch (was %d); LR schedule "
+                                "rebased to step %d — the batch-size "
+                                "change makes bit-exactness with the "
+                                "nominal run impossible by construction",
+                                ipd, old_ipd_live,
+                                carry.epoch * steps_per_epoch
+                                + carry.dispatch * multi)
                     healer.note_devices(int(mesh.devices.size))
 
                 # Optimizer/state from the carry: a restored opt_state
@@ -773,7 +950,23 @@ def fit_detector(
                         if chaos_spec.active:
                             chaos_spec.maybe_sigterm(
                                 epoch * steps_per_epoch + done * multi)
-                        if guard is not None and guard.requested:
+                        if stopper is not None:
+                            # Coordinated preemption (graftquorum): the
+                            # signaled host PROPOSES its next boundary;
+                            # every host folds in its own floor and ALL
+                            # of them drain to the agreed max before the
+                            # one barrier+publish in _honor_preemption.
+                            # The un-signaled steady state costs one
+                            # store read per dispatch.
+                            gdone = epoch * disp_per_epoch + done
+                            if (guard is not None and guard.requested
+                                    and not stop_requested):
+                                stopper.request(gdone)
+                                stop_requested = True
+                            agreed = stopper.check(gdone)
+                            if agreed is not None and gdone >= agreed:
+                                _honor_preemption(epoch, done)
+                        elif guard is not None and guard.requested:
                             _honor_preemption(epoch, done)
                     # pos stays at (epoch, <last dispatch>) until the
                     # epoch-end work below completes: a heal landing
@@ -811,6 +1004,28 @@ def fit_detector(
                     # (data/loader.py).
                     if hasattr(loader, "close"):
                         loader.close()
+                    boundary = (epoch + 1) * disp_per_epoch
+                    if stopper is not None:
+                        # Stop check BEFORE the epoch barrier: a host
+                        # already waiting in the barrier cannot publish
+                        # its drain floor, so a stop requested by a
+                        # mid-epoch peer would idle the fleet until the
+                        # deadline. (The residual race — a request
+                        # landing between this check and the barrier —
+                        # stays bounded by quorum_timeout_s.)
+                        if (guard is not None and guard.requested
+                                and not stop_requested):
+                            stopper.request(boundary)
+                            stop_requested = True
+                        agreed = stopper.check(boundary)
+                        if agreed is not None and boundary >= agreed:
+                            _honor_preemption(epoch + 1, None)
+                    if quorum is not None:
+                        # Epoch-boundary saves get the same publication
+                        # discipline as emergency saves: every host has
+                        # finished the epoch before the leader publishes
+                        # (the unbarriered-publish lint rule's contract).
+                        quorum.barrier(f"epoch/{epoch + 1}")
                     epoch_saved = False
                     if is_primary() and (
                             (epoch + 1) % max(1, checkpoint_period) == 0
@@ -838,7 +1053,20 @@ def fit_detector(
                                          durable=writer is None)
                     if epoch_callback:
                         epoch_callback(epoch, state, bag)
-                    if guard is not None and guard.requested:
+                    if stopper is not None:
+                        # Re-check after the save/callback window — a
+                        # signal that landed during epoch-end work, or a
+                        # peer's request that arrived after the check
+                        # above.
+                        if (guard is not None and guard.requested
+                                and not stop_requested):
+                            stopper.request(boundary)
+                            stop_requested = True
+                        agreed = stopper.check(boundary)
+                        if agreed is not None and boundary >= agreed:
+                            _honor_preemption(epoch + 1, None,
+                                              need_save=not epoch_saved)
+                    elif guard is not None and guard.requested:
                         # Signal landed during epoch-end work: exit at
                         # the boundary. The save just enqueued (if any)
                         # goes durable in the finally below (writer.close
@@ -855,7 +1083,22 @@ def fit_detector(
                 # cap has headroom); anything else propagates untouched.
                 if healer is None or not healer.healable(exc):
                     raise
-                carry = healer.recover(exc, _capture)
+                try:
+                    carry = healer.recover(exc, _capture)
+                except QuorumExcludedError as qexc:
+                    # The quorum sealed a heal round WITHOUT this host
+                    # (it missed the rendezvous deadline): its session
+                    # state is stale relative to the agreed topology.
+                    # Exit resumably (rc 75, no local save — the fleet's
+                    # checkpoints are authoritative) so the supervisor
+                    # rejoins it via --resume auto.
+                    if obs_log.enabled:
+                        obs_log.emit("quorum", kind="excluded",
+                                     error=str(qexc)[:300])
+                    logger.warning("graftquorum: %s — exiting rc %d for "
+                                   "rejoin via --resume auto", qexc,
+                                   PreemptionExit().code)
+                    raise PreemptionExit(None) from qexc
     except BaseException as exc:  # graftlint: disable=broad-except — crash telemetry, re-raised below
         if obs_log.enabled and not isinstance(exc, PreemptionExit):
             import traceback

@@ -236,7 +236,10 @@ class CheckpointWriter:
     Single-process use only: the primary-only save pattern of the
     multi-host path cannot satisfy orbax's cross-process commit barrier,
     so fit_detector falls back to the synchronous `save_checkpoint` when
-    `jax.process_count() > 1`.
+    the coordination world size is > 1 — LOUDLY: the fallback emits one
+    ``checkpoint`` event with ``fallback="sync"`` so a fleet run that
+    silently lost async saving shows it in the event stream (unit-gated
+    in tests/test_resilience.py).
     """
 
     def __init__(self):
@@ -399,10 +402,16 @@ def latest_checkpoint(prefix: str) -> Optional[Tuple[int, Optional[int]]]:
     may carry a topology sidecar the boundary save predates), and the
     choice is logged — never left to directory-listing order. Unfinished
     ``*.tmp-*`` writes never match the name grammar, so a kill mid-save
-    can never be resumed from."""
+    can never be resumed from.
+
+    graftquorum: a multi-host emergency save whose ``graft_meta.json``
+    records FEWER participating hosts than the quorum expected (a host
+    died between the barrier and the commit — a torn fleet save) is
+    SKIPPED with a warning instead of winning the tie-break; resume then
+    falls back to the next-most-advanced complete checkpoint."""
     if not os.path.isdir(prefix):
         return None
-    best = best_name = None
+    candidates = []
     names = set()
     for d in os.listdir(prefix):
         m = _CKPT_NAME_RE.match(d)
@@ -412,18 +421,29 @@ def latest_checkpoint(prefix: str) -> Optional[Tuple[int, Optional[int]]]:
         epoch, dispatch = int(m.group(1)), m.group(2)
         # third element: emergency (dispatch-tagged) outranks an
         # epoch-boundary save at equal progress — the deterministic
-        # tie-break (strict > keeps the first listing otherwise).
+        # tie-break (strict ordering, never directory-listing order).
         key = (epoch, int(dispatch) if dispatch is not None else 0,
                1 if dispatch is not None else 0)
-        if best is None or key > best:
-            best, best_name = key, d
-    if best is None:
-        return None
-    epoch, dispatch, emergency = best
-    if emergency and dispatch == 0 and checkpoint_name(epoch) in names:
-        logger.info(
-            "resume tie at epoch %d: emergency save %s and boundary save "
-            "%s carry the same progress — picking the emergency save "
-            "(deterministic tie-break)", epoch, best_name,
-            checkpoint_name(epoch))
-    return epoch, (dispatch if emergency else None)
+        candidates.append((key, d))
+    for key, best_name in sorted(candidates, reverse=True):
+        epoch, dispatch, emergency = key
+        if emergency:
+            meta = checkpoint_meta(prefix, epoch, dispatch) or {}
+            hosts, expected = meta.get("hosts"), meta.get("host_count")
+            if (hosts is not None and expected is not None
+                    and len(hosts) < int(expected)):
+                logger.warning(
+                    "skipping torn multi-host emergency save %s/%s: its "
+                    "%s records %d of %d participating host(s) — a host "
+                    "died mid-commit; resuming from the next complete "
+                    "checkpoint", prefix, best_name, META_NAME,
+                    len(hosts), int(expected))
+                continue
+        if emergency and dispatch == 0 and checkpoint_name(epoch) in names:
+            logger.info(
+                "resume tie at epoch %d: emergency save %s and boundary "
+                "save %s carry the same progress — picking the emergency "
+                "save (deterministic tie-break)", epoch, best_name,
+                checkpoint_name(epoch))
+        return epoch, (dispatch if emergency else None)
+    return None

@@ -53,7 +53,7 @@ def error_runner(label):
 # ---------------------------------------------------------------------------
 
 def tiny_config(flat: bool = False, obs_dir: str = "", compute: str = "f32",
-                health_every: int = 0):
+                health_every: int = 0, over_extra=None):
     """The 64^2 f32 micro-config of tests/test_flatcore.py, plus
     power-of-two bbox stds: the kill->resume parity gates assert BIT
     exactness, and an emergency save round-trips bbox_pred through
@@ -90,6 +90,10 @@ def tiny_config(flat: bool = False, obs_dir: str = "", compute: str = "f32",
         # The nan_at_step gates run every=1 so the tripwire sees the
         # poisoned dispatch the moment it lands.
         over["obs.health_every"] = health_every
+    if over_extra:
+        # graftquorum gates thread resilience.quorum_* / elastic_mode
+        # overrides through here (dotted config keys).
+        over.update(over_extra)
     cfg = generate_config("resnet50", "synthetic", **over)
     return cfg.with_updates(
         train=replace(cfg.train, flat_params=flat, compute_dtype=compute,
@@ -99,7 +103,7 @@ def tiny_config(flat: bool = False, obs_dir: str = "", compute: str = "f32",
 def run_fit(prefix: str, end_epoch: int = 2, resume=False,
             flat: bool = False, obs_dir: str = "", mesh: str = "1",
             num_images: int = 3, epoch_metrics=None, compute: str = "f32",
-            health_every: int = 0):
+            health_every: int = 0, over_extra=None):
     """num_images x 64^2, seed 0 — returns the final host params.
     Deterministic end to end, so an interrupted+resumed (or graftheal-ed)
     run must match an uninterrupted one bit for bit. ``mesh`` sizes the
@@ -115,7 +119,8 @@ def run_fit(prefix: str, end_epoch: int = 2, resume=False,
     if epoch_metrics is not None:
         def cb(epoch, state, bag):
             epoch_metrics.append((epoch, bag.get()))
-    return fit_detector(tiny_config(flat, obs_dir, compute, health_every),
+    return fit_detector(tiny_config(flat, obs_dir, compute, health_every,
+                                    over_extra=over_extra),
                         ds.gt_roidb(),
                         prefix=prefix, end_epoch=end_epoch, frequent=1000,
                         seed=0, mesh_spec=mesh, resume=resume,
@@ -153,7 +158,29 @@ def main(argv=None):
                    help="one sync checkpoint save (the crash-window probe)")
     p.add_argument("--scale", type=float, default=1.0,
                    help="scale factor on the --crash-save tree")
+    # graftquorum simulated-host mode: N of these processes, each a full
+    # replicated computation, coordinate through a shared FileKVStore as
+    # if they were N pod hosts (parallel/distributed.py sim contract).
+    p.add_argument("--sim-host", type=int, default=None, metavar="I",
+                   help="stand in for host I of a simulated fleet")
+    p.add_argument("--sim-hosts", type=int, default=None, metavar="N",
+                   help="size of the simulated fleet")
+    p.add_argument("--quorum-dir", default="",
+                   help="resilience.quorum_store_dir (shared FileKVStore)")
+    p.add_argument("--quorum-timeout", type=float, default=0.0,
+                   help="resilience.quorum_timeout_s override (0 = keep)")
+    p.add_argument("--elastic-mode", default="",
+                   choices=["", "shrink", "grow", "rescale"],
+                   help="resilience.elastic_mode override")
     args = p.parse_args(argv)
+
+    if args.sim_host is not None or args.sim_hosts is not None:
+        if args.sim_host is None or args.sim_hosts is None:
+            p.error("--sim-host and --sim-hosts go together")
+        # Coordination identity only — jax itself stays single-process
+        # (env must land before mx_rcnn_tpu reads it at call time).
+        os.environ["MXRCNN_SIM_PROCESS_ID"] = str(args.sim_host)
+        os.environ["MXRCNN_SIM_NUM_PROCESSES"] = str(args.sim_hosts)
 
     if args.mesh not in ("", "1", "1x1"):
         # Multi-device mesh in a subprocess: the virtual CPU devices must
@@ -174,9 +201,17 @@ def main(argv=None):
         _crash_save(args.crash_save, scale=args.scale)
         return 0
     if args.fit:
+        over_extra = {}
+        if args.quorum_dir:
+            over_extra["resilience.quorum_store_dir"] = args.quorum_dir
+        if args.quorum_timeout:
+            over_extra["resilience.quorum_timeout_s"] = args.quorum_timeout
+        if args.elastic_mode:
+            over_extra["resilience.elastic_mode"] = args.elastic_mode
         run_fit(args.fit, end_epoch=args.end_epoch, resume=args.resume,
                 flat=args.flat, obs_dir=args.obs_dir, mesh=args.mesh,
-                num_images=args.num_images, compute=args.compute)
+                num_images=args.num_images, compute=args.compute,
+                over_extra=over_extra or None)
         return 0
     p.error("one of --fit / --crash-save is required")
 

@@ -1145,3 +1145,68 @@ def test_health_pull_sanctioned_in_train_health():
             return jnp.sum(jnp.isfinite(x))
     """), "mx_rcnn_tpu/train/health.py", Settings(), ALL_RULES)
     assert "health-host-pull" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# unbarriered-publish
+# ---------------------------------------------------------------------------
+
+def test_unbarriered_publish_flags_guarded_save_without_barrier():
+    findings = lint("""
+        from mx_rcnn_tpu.parallel.distributed import is_primary
+        from mx_rcnn_tpu.train.checkpoint import save_checkpoint
+
+        def emergency_stop(prefix, epoch, params, opt):
+            if is_primary():
+                save_checkpoint(prefix, epoch, params, opt)
+    """)
+    assert "unbarriered-publish" in rules_of(findings)
+    msg = next(f for f in findings
+               if f.rule == "unbarriered-publish").message
+    assert "quorum.barrier" in msg
+
+
+def test_unbarriered_publish_flags_process_index_comparison_guard():
+    findings = lint("""
+        import jax
+        from mx_rcnn_tpu.train.checkpoint import save_checkpoint
+
+        def boundary_save(prefix, epoch, params, opt):
+            if jax.process_index() == 0:
+                save_checkpoint(prefix, epoch, params, opt)
+    """)
+    assert sum(f.rule == "unbarriered-publish" for f in findings) == 1
+
+
+def test_unbarriered_publish_near_miss_barrier_first():
+    """The graftquorum contract: barrier, THEN primary-only publication
+    — in the same function, lexically before the guarded save."""
+    findings = lint("""
+        from mx_rcnn_tpu.parallel.distributed import is_primary
+        from mx_rcnn_tpu.train.checkpoint import save_checkpoint
+
+        def coordinated_stop(quorum, prefix, epoch, params, opt):
+            arrived = quorum.barrier("preempt/stop")
+            if is_primary():
+                save_checkpoint(prefix, epoch, params, opt,
+                                meta={"hosts": sorted(arrived)})
+    """)
+    assert "unbarriered-publish" not in rules_of(findings)
+
+
+def test_unbarriered_publish_near_miss_unguarded_and_foreign_saves():
+    """Single-host saves (no primary guard) and non-checkpoint save()
+    calls are out of scope — the rule targets the multi-host
+    primary-only publication idiom specifically."""
+    findings = lint("""
+        from mx_rcnn_tpu.parallel.distributed import is_primary
+        from mx_rcnn_tpu.train.checkpoint import save_checkpoint
+
+        def single_host(prefix, epoch, params, opt):
+            save_checkpoint(prefix, epoch, params, opt)
+
+        def primary_log(log):
+            if is_primary():
+                log.save()
+    """)
+    assert "unbarriered-publish" not in rules_of(findings)
