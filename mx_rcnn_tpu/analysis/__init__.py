@@ -23,9 +23,10 @@ from mx_rcnn_tpu.analysis.engine import (  # noqa: F401
     LintResult,
     lint_file,
     lint_source,
+    lint_sources,
     run,
 )
 from mx_rcnn_tpu.analysis.settings import Settings, find_repo_root  # noqa: F401
 
-__all__ = ["Finding", "LintResult", "lint_file", "lint_source", "run",
-           "Settings", "find_repo_root"]
+__all__ = ["Finding", "LintResult", "lint_file", "lint_source",
+           "lint_sources", "run", "Settings", "find_repo_root"]

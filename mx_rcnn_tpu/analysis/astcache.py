@@ -1,0 +1,113 @@
+"""On-disk parsed-AST cache — pre-commit stops re-parsing 155 files.
+
+One pickle file (``.graftlint-cache/ast.pkl`` under the repo root) maps
+repo-relative path -> (mtime_ns, size, pickled tree). A hit returns the
+unpickled tree without calling ``ast.parse``; any miss (changed file, new
+file, unreadable blob, interpreter change) silently re-parses — the cache
+is a pure accelerator and every failure path degrades to correctness.
+Writes are atomic (temp file + rename) so concurrent lint runs can share
+one cache without corrupting it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import pickle
+import sys
+import tempfile
+from typing import Dict, Optional, Tuple
+
+#: bump to invalidate every entry (AST pickles are not stable across
+#: interpreter minor versions — the version key guards that too)
+_FORMAT = 1
+_DIR_NAME = ".graftlint-cache"
+_FILE_NAME = "ast.pkl"
+
+
+class AstCache:
+    def __init__(self, path: Optional[str]):
+        self._path = path
+        self._entries: Dict[str, Tuple[int, int, bytes]] = {}
+        self._dirty = False
+        self.hits = 0
+        self.misses = 0
+        if path is None or not os.path.isfile(path):
+            return
+        try:
+            with open(path, "rb") as fh:
+                payload = pickle.load(fh)
+            if (payload.get("format") == _FORMAT
+                    and payload.get("python") == sys.version_info[:2]):
+                self._entries = dict(payload.get("entries", {}))
+        except Exception:  # noqa: BLE001  # graftlint: disable=broad-except — a corrupt/foreign cache must never break the lint run; it is rebuilt below
+            self._entries = {}
+
+    @staticmethod
+    def open(root: str, enabled: bool = True) -> "AstCache":
+        """Cache under ``<root>/.graftlint-cache``; a disabled cache is a
+        no-op object (every parse is a miss, nothing is written)."""
+        if not enabled:
+            return AstCache(None)
+        return AstCache(os.path.join(root, _DIR_NAME, _FILE_NAME))
+
+    def parse(self, abs_path: str, rel_path: str,
+              source: str) -> Optional[ast.AST]:
+        """Parse ``source`` (already read from ``abs_path``), consulting
+        the cache keyed by (path, mtime, size). Returns None on
+        SyntaxError (callers report it; nothing is cached)."""
+        key_stat = self._stat(abs_path)
+        if key_stat is not None:
+            entry = self._entries.get(rel_path)
+            if entry is not None and entry[:2] == key_stat:
+                try:
+                    tree = pickle.loads(entry[2])
+                    self.hits += 1
+                    return tree
+                except Exception:  # noqa: BLE001  # graftlint: disable=broad-except — an unreadable blob is a miss, not an error
+                    pass
+        self.misses += 1
+        try:
+            tree = ast.parse(source, filename=rel_path)
+        except SyntaxError:
+            self._entries.pop(rel_path, None)
+            return None
+        if key_stat is not None and self._path is not None:
+            try:
+                blob = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
+            except Exception:  # noqa: BLE001  # graftlint: disable=broad-except — an unpicklable tree just stays uncached
+                return tree
+            self._entries[rel_path] = (key_stat[0], key_stat[1], blob)
+            self._dirty = True
+        return tree
+
+    @staticmethod
+    def _stat(path: str) -> Optional[Tuple[int, int]]:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
+
+    def save(self):
+        """Atomic write-back; errors (read-only tree, full disk) are
+        swallowed — the cache is an accelerator, not a product."""
+        if not self._dirty or self._path is None:
+            return
+        payload = {"format": _FORMAT, "python": sys.version_info[:2],
+                   "entries": self._entries}
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(self._path), suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    pickle.dump(payload, fh,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, self._path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        except OSError:
+            pass
+        self._dirty = False

@@ -1,0 +1,549 @@
+"""graftsight: whole-program call graph and project-wide jit reachability.
+
+tracing.py answers "which functions run under a JAX trace?" for ONE file;
+this module answers it for the whole tree. A ``Program`` indexes every
+module's imports, module-level defs, classes and methods, discovers the
+jit/pjit/pallas/shard_map roots (including ``jax.jit(imported_fn)``
+cross-module roots), and closes the traced set transitively over a
+module-qualified call graph:
+
+- bare-name calls, resolved lexically (enclosing scopes outward), then
+  through the function-local value environment (parameter defaults and
+  local assignments that bind function references — the
+  ``make_train_step(forward_fn=forward_train)`` idiom), then against the
+  module's top level, then through imports;
+- attribute calls on imported modules (``checkpoint.load_checkpoint``),
+  plain and aliased ``from``-imports, and relative imports;
+- method calls resolved through class defs: ``self.m()`` walks the
+  enclosing class and its resolvable bases, ``obj.m()`` uses ``obj``'s
+  inferred type (constructor assignment or parameter/variable
+  annotation), ``obj(...)`` resolves to ``__call__``, and
+  ``self.attr.m()`` goes through the class's attribute types
+  (``self.model = FasterRCNN(...)`` in ``__init__``).
+
+Anything dynamic — ``getattr``-dispatch, registry lookups, values
+returned from calls — resolves to nothing and therefore propagates
+nothing: the closure stays an under-approximation, never crashing and
+never over-flagging host code (the same contract tracing.py documents
+for its file-local pass).
+
+The engine builds one Program per run over the SAME parsed trees it
+lints, then seeds each file's TraceAnalysis with the program's traced
+nodes for that file, so every reachability-consuming rule becomes
+interprocedural with no rule changes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from mx_rcnn_tpu.analysis.tracing import (
+    FuncNode, FuncOrLambda, _ScopeIndex, dotted_name, jit_expr_name,
+)
+
+
+def module_name_for(rel_path: str) -> str:
+    """'mx_rcnn_tpu/train/step.py' -> 'mx_rcnn_tpu.train.step';
+    a package's __init__.py maps to the package itself."""
+    name = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    parts = [p for p in name.split("/") if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _ClassInfo:
+    __slots__ = ("name", "node", "bases", "methods", "attr_types")
+
+    def __init__(self, node: ast.ClassDef):
+        self.name = node.name
+        self.node = node
+        #: base-class expressions, resolved lazily against the module
+        self.bases: List[ast.AST] = list(node.bases)
+        self.methods: Dict[str, ast.AST] = {}
+        #: self.<attr> -> type expression (from ``self.x = Cls(...)``
+        #: assignments and class-level annotations)
+        self.attr_types: Dict[str, ast.AST] = {}
+        for item in node.body:
+            if isinstance(item, FuncNode):
+                self.methods.setdefault(item.name, item)
+            elif (isinstance(item, ast.AnnAssign)
+                  and isinstance(item.target, ast.Name)):
+                self.attr_types.setdefault(item.target.id, item.annotation)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and isinstance(sub.value, ast.Call)):
+                        self.attr_types.setdefault(tgt.attr, sub.value.func)
+
+
+class _ModuleInfo:
+    __slots__ = ("name", "rel_path", "tree", "parents", "scope",
+                 "imports", "defs", "classes", "class_of", "_own_cache")
+
+    def __init__(self, name: str, rel_path: str, tree: ast.AST):
+        self.name = name
+        self.rel_path = rel_path
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.scope = _ScopeIndex()
+        self.scope.visit(tree)
+        #: local name -> dotted target ('pkg.mod' or 'pkg.mod.symbol')
+        self.imports: Dict[str, str] = {}
+        #: module-level function defs
+        self.defs: Dict[str, ast.AST] = {}
+        #: module-level classes
+        self.classes: Dict[str, _ClassInfo] = {}
+        #: method/function node -> enclosing _ClassInfo (methods only)
+        self.class_of: Dict[ast.AST, _ClassInfo] = {}
+        self._own_cache: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        for item in tree.body if hasattr(tree, "body") else []:
+            if isinstance(item, FuncNode):
+                self.defs.setdefault(item.name, item)
+            elif isinstance(item, ast.ClassDef):
+                info = _ClassInfo(item)
+                self.classes.setdefault(item.name, info)
+                for m in info.methods.values():
+                    self.class_of[m] = info
+        self._index_imports(tree)
+
+    def _index_imports(self, tree: ast.AST):
+        pkg_parts = self.name.split(".") if self.name else []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.imports[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split(".")[0]
+                        self.imports.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # relative: level 1 strips the module's own leaf
+                    base_parts = pkg_parts[:len(pkg_parts) - node.level]
+                    base = ".".join(base_parts + (
+                        [node.module] if node.module else []))
+                else:
+                    base = node.module or ""
+                if not base:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports[local] = f"{base}.{alias.name}"
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, FuncOrLambda):
+            cur = self.parents.get(cur)
+        return cur
+
+    def enclosing_class(self, node: ast.AST) -> Optional[_ClassInfo]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return self.classes.get(cur.name)
+            cur = self.parents.get(cur)
+        return None
+
+    def resolve_def(self, name: str, at_node: ast.AST) -> Optional[ast.AST]:
+        """Lexical def resolution, innermost scope outward (the same walk
+        tracing.TraceAnalysis does file-locally, own scope included)."""
+        fn = self.enclosing_function(at_node)
+        while fn is not None:
+            chain = self.scope.chain_of.get(fn, ())
+            for scope in (self._own_scope(fn),) + tuple(reversed(chain)):
+                if scope and name in scope:
+                    return scope[name]
+            fn = self.enclosing_function(fn)
+        return self.scope.module_scope.get(name)
+
+    def _own_scope(self, fn: ast.AST) -> Dict[str, ast.AST]:
+        cached = self._own_cache.get(fn)
+        if cached is not None:
+            return cached
+        out: Dict[str, ast.AST] = {}
+        for child in ast.walk(fn):
+            if child is fn or not isinstance(child, FuncNode):
+                continue
+            if self.enclosing_function(child) is fn:
+                out.setdefault(child.name, child)
+        self._own_cache[fn] = out
+        return out
+
+
+#: abstract values the lightweight env tracks
+_FUNC, _CLASS, _INSTANCE = "func", "class", "instance"
+Value = Tuple[str, ast.AST, "_ModuleInfo"]  # (kind, node-or-classinfo, mod)
+
+
+class Program:
+    """Whole-program index + traced-set closure over all added modules."""
+
+    def __init__(self):
+        self.modules: Dict[str, _ModuleInfo] = {}
+        self._by_rel: Dict[str, _ModuleInfo] = {}
+        #: traced function nodes, per module name
+        self._traced: Dict[str, Set[ast.AST]] = {}
+        self._env_cache: Dict[ast.AST, Dict[str, List[Value]]] = {}
+        self._finalized = False
+
+    # -- construction ------------------------------------------------------
+
+    def add_module(self, rel_path: str, tree: ast.AST):
+        mi = _ModuleInfo(module_name_for(rel_path), rel_path, tree)
+        self.modules[mi.name] = mi
+        self._by_rel[rel_path] = mi
+
+    def finalize(self):
+        """Discover roots in every module, then close transitively."""
+        work: List[Tuple[_ModuleInfo, ast.AST]] = []
+
+        def mark(mi: _ModuleInfo, node: ast.AST):
+            traced = self._traced.setdefault(mi.name, set())
+            if node not in traced:
+                traced.add(node)
+                work.append((mi, node))
+
+        for mi in self.modules.values():
+            for mi2, node in self._find_roots(mi):
+                mark(mi2, node)
+        while work:
+            mi, fn = work.pop()
+            for call in (n for n in ast.walk(fn)
+                         if isinstance(n, ast.Call)):
+                for kind, target, tmod in self._callee_values(mi, call):
+                    if kind == _FUNC and isinstance(target, FuncOrLambda):
+                        mark(tmod, target)
+                    elif kind == _CLASS:
+                        init = self._find_method(target, tmod, "__init__")
+                        if init is not None:
+                            mark(init[1], init[0])
+        self._finalized = True
+
+    # -- queries -----------------------------------------------------------
+
+    def traced_nodes(self, rel_path: str) -> Set[ast.AST]:
+        """Function nodes in ``rel_path`` that the whole-program closure
+        marks as jit-reachable (seed for the file's TraceAnalysis)."""
+        mi = self._by_rel.get(rel_path)
+        if mi is None:
+            return set()
+        return self._traced.get(mi.name, set())
+
+    def module_for(self, rel_path: str) -> Optional[_ModuleInfo]:
+        return self._by_rel.get(rel_path)
+
+    def resolve_symbol(self, rel_path: str, name: str,
+                       at_node: ast.AST) -> Optional[ast.AST]:
+        """Resolve a (possibly dotted) name used in ``rel_path`` to a
+        function def anywhere in the program — rules use this to chase
+        imported factories (e.g. donation-hazard's step builders)."""
+        mi = self._by_rel.get(rel_path)
+        if mi is None:
+            return None
+        expr = ast.parse(name, mode="eval").body if "." in name else None
+        if expr is not None:
+            for kind, node, _ in self._resolve_dotted(mi, name, at_node):
+                if kind == _FUNC:
+                    return node
+            return None
+        local = mi.resolve_def(name, at_node)
+        if local is not None:
+            return local
+        for kind, node, _ in self._lookup_module_level(mi, name):
+            if kind == _FUNC:
+                return node
+        return None
+
+    def function_defs_of(self, rel_path: str, expr: ast.AST,
+                         at_node: ast.AST) -> List[ast.AST]:
+        """Function defs an expression used in ``rel_path`` may refer to
+        (Name, Attribute, call-of-constructor...). Rules use this to
+        chase imported factories; unresolvable -> []."""
+        mi = self._by_rel.get(rel_path)
+        if mi is None:
+            return []
+        return [n for k, n, _ in self._value_of(mi, expr, at_node)
+                if k == _FUNC and isinstance(n, FuncNode)]
+
+    # -- root discovery ----------------------------------------------------
+
+    def _find_roots(self, mi: _ModuleInfo
+                    ) -> Iterable[Tuple[_ModuleInfo, ast.AST]]:
+        for node in ast.walk(mi.tree):
+            if isinstance(node, FuncNode):
+                for deco in node.decorator_list:
+                    if jit_expr_name(deco):
+                        yield mi, node
+            elif isinstance(node, ast.Call) and jit_expr_name(node.func):
+                if not node.args:
+                    continue
+                target = node.args[0]
+                if (isinstance(target, ast.Call)
+                        and dotted_name(target.func)
+                        in ("partial", "functools.partial")
+                        and target.args):
+                    target = target.args[0]
+                if isinstance(target, ast.Lambda):
+                    yield mi, target
+                    continue
+                for kind, tnode, tmod in self._value_of(mi, target, node):
+                    if kind == _FUNC and isinstance(tnode, FuncOrLambda):
+                        yield tmod, tnode
+
+    # -- resolution --------------------------------------------------------
+
+    def _callee_values(self, mi: _ModuleInfo,
+                       call: ast.Call) -> List[Value]:
+        out = list(self._value_of(mi, call.func, call))
+        resolved: List[Value] = []
+        for kind, node, tmod in out:
+            if kind == _INSTANCE:  # obj(...) -> __call__
+                m = self._find_method(node, tmod, "__call__")
+                if m is not None:
+                    resolved.append((_FUNC, m[0], m[1]))
+            else:
+                resolved.append((kind, node, tmod))
+        return resolved
+
+    def _value_of(self, mi: _ModuleInfo, expr: ast.AST,
+                  at_node: ast.AST) -> List[Value]:
+        """Abstract value(s) of an expression: function refs, classes,
+        instances. Unresolvable -> []."""
+        if isinstance(expr, ast.BoolOp):
+            out: List[Value] = []
+            for v in expr.values:
+                out.extend(self._value_of(mi, v, at_node))
+            return out
+        if isinstance(expr, ast.Call):
+            # only constructor calls produce a value we track
+            inner = self._value_of(mi, expr.func, at_node)
+            return [(_INSTANCE, n, m) for k, n, m in inner if k == _CLASS]
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(mi, expr.id, at_node)
+        if isinstance(expr, ast.Attribute):
+            return self._resolve_attribute(mi, expr, at_node)
+        return []
+
+    def _resolve_name(self, mi: _ModuleInfo, name: str,
+                      at_node: ast.AST) -> List[Value]:
+        local = mi.resolve_def(name, at_node)
+        if local is not None:
+            return [(_FUNC, local, mi)]
+        # function-local env (params with defaults / annotations, local
+        # assignments binding function or class references)
+        fn = mi.enclosing_function(at_node)
+        while fn is not None:
+            env = self._env_for(mi, fn)
+            if name in env:
+                return env[name]
+            fn = mi.enclosing_function(fn)
+        return self._lookup_module_level(mi, name)
+
+    def _lookup_module_level(self, mi: _ModuleInfo,
+                             name: str) -> List[Value]:
+        if name in mi.defs:
+            return [(_FUNC, mi.defs[name], mi)]
+        if name in mi.classes:
+            return [(_CLASS, mi.classes[name], mi)]
+        if name in mi.imports:
+            return self._resolve_dotted(mi, mi.imports[name], None)
+        return []
+
+    def _resolve_attribute(self, mi: _ModuleInfo, expr: ast.Attribute,
+                           at_node: ast.AST) -> List[Value]:
+        parts: List[str] = []
+        cur: ast.AST = expr
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        parts.reverse()
+        if not isinstance(cur, ast.Name):
+            return []
+        root = cur.id
+        # self.m() / self.attr.m() through the enclosing class
+        if root == "self":
+            cls = mi.enclosing_class(at_node)
+            if cls is None:
+                return []
+            return self._walk_members(cls, mi, parts)
+        # a local value with a known type: obj.m()
+        for kind, node, tmod in self._resolve_name(mi, root, at_node):
+            if kind == _INSTANCE and parts:
+                m = self._find_method(node, tmod, parts[0])
+                if m is not None and len(parts) == 1:
+                    return [(_FUNC, m[0], m[1])]
+            elif kind == _CLASS and parts:
+                return self._walk_class_members(node, tmod, parts)
+        # dotted path through imports: mod.sub.fn(...)
+        if root in mi.imports:
+            dotted = ".".join([mi.imports[root]] + parts)
+            return self._resolve_dotted(mi, dotted, at_node)
+        return []
+
+    def _walk_members(self, cls: _ClassInfo, mi: _ModuleInfo,
+                      parts: Sequence[str]) -> List[Value]:
+        """Resolve self.<a>.<b>... : methods directly, or through the
+        class's attribute types (self.model = FasterRCNN(...))."""
+        if not parts:
+            return []
+        if len(parts) == 1:
+            m = self._find_method(cls, mi, parts[0])
+            return [(_FUNC, m[0], m[1])] if m is not None else []
+        ann = cls.attr_types.get(parts[0])
+        if ann is None:
+            return []
+        for kind, node, tmod in self._type_of_expr(mi, ann):
+            if kind == _CLASS:
+                return self._walk_class_members(node, tmod, parts[1:],
+                                                as_instance=True)
+        return []
+
+    def _walk_class_members(self, cls: _ClassInfo, mi: _ModuleInfo,
+                            parts: Sequence[str],
+                            as_instance: bool = False) -> List[Value]:
+        if len(parts) == 1:
+            m = self._find_method(cls, mi, parts[0])
+            return [(_FUNC, m[0], m[1])] if m is not None else []
+        return []
+
+    def _type_of_expr(self, mi: _ModuleInfo,
+                      expr: ast.AST) -> List[Value]:
+        """Resolve a type-ish expression (annotation or constructor
+        callee) to a class. String annotations are accepted."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            try:
+                expr = ast.parse(expr.value, mode="eval").body
+            except SyntaxError:
+                return []
+        name = dotted_name(expr)
+        if name is None:
+            return []
+        if "." not in name:
+            if name in mi.classes:
+                return [(_CLASS, mi.classes[name], mi)]
+            if name in mi.imports:
+                return [v for v in self._resolve_dotted(
+                    mi, mi.imports[name], None) if v[0] == _CLASS]
+            return []
+        root, rest = name.split(".", 1)
+        if root in mi.imports:
+            return [v for v in self._resolve_dotted(
+                mi, f"{mi.imports[root]}.{rest}", None) if v[0] == _CLASS]
+        return []
+
+    def _resolve_dotted(self, mi: _ModuleInfo, dotted: str,
+                        at_node: Optional[ast.AST]) -> List[Value]:
+        """Resolve a fully-dotted path: longest module prefix in the
+        program, then symbols through that module's top level (one import
+        indirection — re-exports — is followed)."""
+        parts = dotted.split(".")
+        for cut in range(len(parts), 0, -1):
+            mod_name = ".".join(parts[:cut])
+            target = self.modules.get(mod_name)
+            if target is None:
+                continue
+            rest = parts[cut:]
+            if not rest:
+                return []  # a module itself is not a callable value
+            head = rest[0]
+            if head in target.defs:
+                return ([(_FUNC, target.defs[head], target)]
+                        if len(rest) == 1 else [])
+            if head in target.classes:
+                cls = target.classes[head]
+                if len(rest) == 1:
+                    return [(_CLASS, cls, target)]
+                return self._walk_class_members(cls, target, rest[1:])
+            if head in target.imports:  # re-export indirection
+                return self._resolve_dotted(
+                    target, ".".join([target.imports[head]] + rest[1:]),
+                    None)
+            return []
+        return []
+
+    def _find_method(self, cls: _ClassInfo, mi: _ModuleInfo, name: str,
+                     _seen: Optional[Set[int]] = None
+                     ) -> Optional[Tuple[ast.AST, _ModuleInfo]]:
+        """Method lookup through the class and its resolvable bases."""
+        if _seen is None:
+            _seen = set()
+        if id(cls) in _seen:
+            return None
+        _seen.add(id(cls))
+        if name in cls.methods:
+            return cls.methods[name], mi
+        for base in cls.bases:
+            for kind, node, tmod in self._type_of_expr(mi, base):
+                if kind == _CLASS:
+                    found = self._find_method(node, tmod, name, _seen)
+                    if found is not None:
+                        return found
+        return None
+
+    # -- function-local value environments ---------------------------------
+
+    def _env_for(self, mi: _ModuleInfo,
+                 fn: ast.AST) -> Dict[str, List[Value]]:
+        env = self._env_cache.get(fn)
+        if env is not None:
+            return env
+        env = {}
+        self._env_cache[fn] = env  # placed first: guards self-recursion
+        if isinstance(fn, FuncNode):
+            a = fn.args
+            params = list(a.posonlyargs) + list(a.args)
+            defaults = list(a.defaults)
+            # defaults align with the TAIL of the positional params
+            for param, default in zip(params[len(params)
+                                             - len(defaults):], defaults):
+                vals = self._value_of(mi, default, fn)
+                if vals:
+                    env[param.arg] = vals
+            for param in params + list(a.kwonlyargs):
+                if param.annotation is not None:
+                    types = self._type_of_expr(mi, param.annotation)
+                    if types:
+                        env.setdefault(param.arg, [
+                            (_INSTANCE, n, m) for _, n, m in types])
+            for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                if default is not None:
+                    vals = self._value_of(mi, default, fn)
+                    if vals:
+                        env.setdefault(param.arg, vals)
+        # local assignments binding function/class references or
+        # constructor results — only defs whose nearest scope is fn
+        for node in ast.walk(fn):
+            if mi.enclosing_function(node) is not fn:
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                vals = self._value_of(mi, node.value, node)
+                if vals:
+                    env.setdefault(node.targets[0].id, vals)
+            elif (isinstance(node, ast.AnnAssign)
+                  and isinstance(node.target, ast.Name)):
+                types = self._type_of_expr(mi, node.annotation)
+                if types:
+                    env.setdefault(node.target.id, [
+                        (_INSTANCE, n, m) for _, n, m in types])
+        return env
+
+
+def build_program(sources: Dict[str, ast.AST]) -> Program:
+    """Program over {rel_path: parsed tree} — the engine's entry point."""
+    program = Program()
+    for rel_path, tree in sources.items():
+        if tree is not None:
+            program.add_module(rel_path, tree)
+    program.finalize()
+    return program

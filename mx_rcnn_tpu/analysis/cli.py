@@ -39,8 +39,46 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule names to skip for this run")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only files changed vs `git merge-base HEAD "
+                        "main` (plus untracked); the call graph still "
+                        "covers the whole tree, so cross-module "
+                        "reachability stays exact. Falls back to a full "
+                        "run when git is unavailable")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-rule finding counts and wall time")
+    p.add_argument("--no-cache", action="store_true",
+                   help="skip the on-disk parsed-AST cache")
     p.add_argument("--root", default=None, help=argparse.SUPPRESS)
     return p
+
+
+def _git_changed_files(root: str) -> Optional[List[str]]:
+    """Repo-relative paths changed vs merge-base with main, plus
+    untracked files; None when git can't answer (not a repo, no main)."""
+    import subprocess
+
+    def git(*cmd: str) -> Optional[str]:
+        try:
+            proc = subprocess.run(
+                ("git", "-C", root) + cmd, capture_output=True,
+                text=True, timeout=30, check=False)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return proc.stdout if proc.returncode == 0 else None
+
+    base = git("merge-base", "HEAD", "main")
+    if base is None:
+        return None
+    changed = git("diff", "--name-only", base.strip(), "HEAD")
+    worktree = git("diff", "--name-only", "HEAD")
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    if changed is None or worktree is None or untracked is None:
+        return None
+    out = set()
+    for blob in (changed, worktree, untracked):
+        out.update(ln.strip() for ln in blob.splitlines() if ln.strip())
+    return sorted(out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -85,7 +123,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                    or rel_path.startswith(s.rstrip("/") + "/")
                    for s in scopes)
 
-    result = engine.run(paths, root, settings, entries)
+    lint_only = None
+    if args.changed_only:
+        changed = _git_changed_files(root)
+        if changed is None:
+            print("graftlint: --changed-only: git unavailable, "
+                  "linting everything", file=sys.stderr)
+        else:
+            lint_only = [p for p in changed if p.endswith(".py")]
+            # Only linted files may be judged for baseline staleness.
+            scopes = list(lint_only)
+            if not lint_only:
+                print("graftlint: --changed-only: no python files "
+                      "changed, nothing to lint")
+                return 0
+
+    result = engine.run(paths, root, settings, entries,
+                        lint_only=lint_only,
+                        use_cache=not args.no_cache)
 
     if args.write_baseline:
         keep = [e for e in all_entries if not in_scope(e["path"])]
@@ -109,6 +164,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     for path, rule, text in stale:
         print(f"{path}: [stale-baseline] entry no longer matches "
               f"anything: [{rule}] {text!r}")
+
+    if args.stats:
+        for name in sorted(result.rule_stats):
+            count, secs = result.rule_stats[name]
+            print(f"graftlint: rule {name:24s} {int(count):4d} finding"
+                  f"{'s' if count != 1 else ' '}  {secs * 1000:7.1f} ms")
+        print(f"graftlint: wall {result.wall_s:.2f}s  ast-cache "
+              f"{result.cache_hits} hits / {result.cache_misses} misses")
 
     n, b = len(result.findings), len(result.baselined)
     summary = (f"graftlint: {result.files_checked} files, "

@@ -26,6 +26,7 @@ import ast
 import io
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence
@@ -59,13 +60,17 @@ class FileContext:
     """Per-file parse result + lazy shared analyses handed to every rule."""
 
     def __init__(self, path: str, rel_path: str, source: str,
-                 tree: ast.AST, settings):
+                 tree: ast.AST, settings, program=None):
         self.path = path
         self.rel_path = rel_path
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
         self.settings = settings
+        #: callgraph.Program for whole-tree runs (None for single-snippet
+        #: lint_source calls) — rules use it to chase imported symbols,
+        #: and `traced` seeds itself from its cross-module closure.
+        self.program = program
         self._traced = None
         self._comments = None
         # Parent links let rules walk outward (e.g. "is this node inside a
@@ -77,11 +82,16 @@ class FileContext:
 
     @property
     def traced(self):
-        """tracing.TraceAnalysis for this file (computed on first use)."""
+        """tracing.TraceAnalysis for this file (computed on first use),
+        seeded with the whole-program closure when a Program is live —
+        jit rules are interprocedural exactly when the run is."""
         if self._traced is None:
             from mx_rcnn_tpu.analysis import tracing
 
-            self._traced = tracing.TraceAnalysis(self.tree, self.parents)
+            extra = (self.program.traced_nodes(self.rel_path)
+                     if self.program is not None else ())
+            self._traced = tracing.TraceAnalysis(
+                self.tree, self.parents, extra_traced=extra)
         return self._traced
 
     def line_text(self, lineno: int) -> str:
@@ -129,6 +139,11 @@ class LintResult:
     findings: List[Finding] = field(default_factory=list)
     baselined: List[Finding] = field(default_factory=list)
     files_checked: int = 0
+    #: rule name -> [finding count (live+baselined), seconds in check()]
+    rule_stats: Dict[str, List[float]] = field(default_factory=dict)
+    wall_s: float = 0.0
+    cache_hits: int = 0
+    cache_misses: int = 0
 
 
 def iter_python_files(paths: Sequence[str], root: str,
@@ -175,7 +190,8 @@ def lint_file(path: str, root: str, settings, rules) -> List[Finding]:
 
 
 def lint_source(source: str, rel_path: str, settings, rules,
-                abs_path: Optional[str] = None) -> List[Finding]:
+                abs_path: Optional[str] = None,
+                program=None) -> List[Finding]:
     """Lint one source blob; the API tests drive this directly."""
     try:
         tree = ast.parse(source, filename=rel_path)
@@ -183,30 +199,119 @@ def lint_source(source: str, rel_path: str, settings, rules,
         return [Finding(path=rel_path, rule="syntax",
                         line=exc.lineno or 0, col=(exc.offset or 0),
                         message=f"syntax error: {exc.msg}")]
-    ctx = FileContext(abs_path or rel_path, rel_path, source, tree, settings)
+    return _lint_tree(source, rel_path, tree, settings, rules,
+                      abs_path=abs_path, program=program)
+
+
+def _lint_tree(source: str, rel_path: str, tree: ast.AST, settings, rules,
+               abs_path: Optional[str] = None, program=None,
+               rule_stats: Optional[Dict] = None) -> List[Finding]:
+    ctx = FileContext(abs_path or rel_path, rel_path, source, tree,
+                      settings, program=program)
     out: List[Finding] = []
     for rule in rules:
         if rule.NAME in settings.disable:
             continue
+        t0 = time.perf_counter() if rule_stats is not None else 0.0
+        n = 0
         for f in rule.check(ctx):
             if not ctx.is_suppressed(f):
                 out.append(f)
+                n += 1
+        if rule_stats is not None:
+            stat = rule_stats.setdefault(rule.NAME, [0, 0.0])
+            stat[0] += n
+            stat[1] += time.perf_counter() - t0
     out.sort(key=lambda f: (f.line, f.col, f.rule))
     return out
 
 
+def lint_sources(files: Dict[str, str], settings=None,
+                 rules=None) -> List[Finding]:
+    """Lint a multi-file mini-program given as {rel_path: source} — the
+    graftsight cross-module fixtures drive this: reachability closes over
+    ALL the given files before any rule runs."""
+    from mx_rcnn_tpu.analysis import callgraph
+    from mx_rcnn_tpu.analysis.settings import Settings
+
+    if settings is None:
+        settings = Settings()
+    if rules is None:
+        from mx_rcnn_tpu.analysis.rules import ALL_RULES as rules
+
+    trees: Dict[str, Optional[ast.AST]] = {}
+    out: List[Finding] = []
+    for rel_path, source in files.items():
+        try:
+            trees[rel_path] = ast.parse(source, filename=rel_path)
+        except SyntaxError as exc:
+            trees[rel_path] = None
+            out.append(Finding(path=rel_path, rule="syntax",
+                               line=exc.lineno or 0, col=(exc.offset or 0),
+                               message=f"syntax error: {exc.msg}"))
+    program = callgraph.build_program(trees)
+    for rel_path, source in files.items():
+        tree = trees[rel_path]
+        if tree is not None:
+            out.extend(_lint_tree(source, rel_path, tree, settings, rules,
+                                  program=program))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
 def run(paths: Sequence[str], root: str, settings,
-        baseline_entries=None) -> LintResult:
-    """Lint ``paths``, splitting findings into live vs baselined."""
+        baseline_entries=None, *, lint_only: Optional[Sequence[str]] = None,
+        use_cache: bool = True) -> LintResult:
+    """Lint ``paths``, splitting findings into live vs baselined.
+
+    Two phases: every file under ``paths`` is parsed (through the on-disk
+    AST cache) and indexed into one callgraph.Program — reachability is
+    always whole-program — then rules run per file. ``lint_only``
+    restricts phase two to a subset of repo-relative paths (the CLI's
+    ``--changed-only``) without narrowing the program.
+    """
     from mx_rcnn_tpu.analysis import baseline as baseline_mod
+    from mx_rcnn_tpu.analysis import callgraph
+    from mx_rcnn_tpu.analysis.astcache import AstCache
     from mx_rcnn_tpu.analysis.rules import ALL_RULES
 
+    t_start = time.perf_counter()
     result = LintResult()
     matcher = baseline_mod.Matcher(baseline_entries or [])
+    cache = AstCache.open(root, enabled=use_cache)
+
+    parsed: List[tuple] = []  # (abs, rel, source, tree-or-None)
+    program = callgraph.Program()
     for path in iter_python_files(paths, root, settings.exclude):
-        findings = lint_file(path, root, settings, ALL_RULES)
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        tree = cache.parse(path, rel, source)
+        parsed.append((path, rel, source, tree))
+        if tree is not None:
+            program.add_module(rel, tree)
+    program.finalize()
+    cache.save()
+    result.cache_hits, result.cache_misses = cache.hits, cache.misses
+
+    only = (None if lint_only is None
+            else {p.replace(os.sep, "/") for p in lint_only})
+    for path, rel, source, tree in parsed:
+        if only is not None and rel not in only:
+            continue
+        if tree is None:  # syntax error — re-derive the finding
+            findings = lint_source(source, rel, settings, ALL_RULES,
+                                   abs_path=path)
+        else:
+            findings = _lint_tree(source, rel, tree, settings, ALL_RULES,
+                                  abs_path=path, program=program,
+                                  rule_stats=result.rule_stats)
         result.files_checked += 1
         for f in findings:
             (result.baselined if matcher.consume(f)
              else result.findings).append(f)
+    result.wall_s = time.perf_counter() - t_start
     return result

@@ -8,6 +8,7 @@ from mx_rcnn_tpu.analysis.rules import (
     cfg_contract,
     chaos_site,
     donation,
+    donation_hazard,
     dtype_cast,
     excepts,
     flat_state,
@@ -17,6 +18,7 @@ from mx_rcnn_tpu.analysis.rules import (
     prng,
     retry,
     shapes,
+    thread_race,
     time_in_jit,
     unbarriered_publish,
 )
@@ -26,6 +28,7 @@ ALL_RULES = (
     time_in_jit,
     shapes,
     donation,
+    donation_hazard,
     prng,
     cfg_contract,
     excepts,
@@ -35,6 +38,7 @@ ALL_RULES = (
     chaos_site,
     dtype_cast,
     health_pull,
+    thread_race,
     unbarriered_publish,
 )
 

@@ -1,0 +1,284 @@
+"""donation-hazard: host (numpy-backed) trees donated into a jitted call.
+
+The repo's costliest recurring bug family, root-caused three separate
+times (PR 4's flat crash, PR 5's segfault, PR 7's 1e18-loss heap
+corruption): a pytree whose leaves are numpy arrays — fresh from
+``np.*`` construction, ``jax.device_get``, a checkpoint restore
+(``load_checkpoint``) or a heal-carry capture (``host_tree_copy``) —
+is passed at a donated position of a ``donate_argnums``-bearing jitted
+call. On CPU the donated buffer IS the numpy array's memory: XLA writes
+the new state into it while the caller still holds views, corrupting
+the heap long after the call returns. The fix is always the same:
+``jax.device_put`` the tree first, so donation consumes a device copy.
+
+The rule is a lexical-order taint walk per scope: names bound from a
+host-tree source (``np.``/``numpy.`` calls, ``jax.device_get``, plus
+``[tool.graftlint] host-tree-sources`` — default ``load_checkpoint``,
+``host_tree_copy``) are tainted; rebinding through ``jax.device_put``
+cleanses; passing a tainted name (or a direct source call) at a donated
+position flags. Donating callees are resolved through graftsight where
+a Program is live (imported step factories included), else file-locally:
+
+- ``jax.jit(f, donate_argnums=<literal>)`` called immediately or bound
+  to a local name;
+- a def decorated ``@partial(jax.jit, donate_argnums=<literal>)``;
+- a name bound from a factory whose ``return jax.jit(...,
+  donate_argnums=<literal>)`` (the ``make_train_step`` shape).
+
+Only LITERAL donate_argnums count: ``donate_argnums=(0,) if donate
+else ()`` is unresolvable statically and — deliberately — exactly the
+sanctioned ``fit_detector`` CPU-no-donate path, which must stay a
+near-miss, not a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import (
+    JIT_DONATABLE, FuncNode, dotted_name, jit_call_kwargs, jit_expr_name,
+)
+
+NAME = "donation-hazard"
+RATIONALE = ("a numpy-backed host tree donated into a jitted call is "
+             "freed/overwritten under the caller (PR 5/7 heap "
+             "corruption) — jax.device_put it first")
+
+_DEVICE_PUT = ("jax.device_put", "device_put")
+_DEVICE_GET = ("jax.device_get", "device_get")
+
+
+def _donate_literal(call: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Donated positional indices if ``call`` is a jit-like call with a
+    LITERAL donate_argnums; None otherwise (incl. conditional exprs)."""
+    if not isinstance(call, ast.Call):
+        return None
+    if jit_expr_name(call.func) not in JIT_DONATABLE:
+        return None
+    for kw in jit_call_kwargs(call.func) + list(call.keywords):
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            return tuple(e.value for e in v.elts)
+        return None  # computed/conditional — not statically donating
+    return None
+
+
+def _decorated_donate(fn: ast.AST) -> Optional[Tuple[int, ...]]:
+    if not isinstance(fn, FuncNode):
+        return None
+    for deco in fn.decorator_list:
+        if jit_expr_name(deco) is None:
+            continue
+        for kw in jit_call_kwargs(deco):
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, int) for e in v.elts):
+                return tuple(e.value for e in v.elts)
+    return None
+
+
+def _returned_donate(fn: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Donate indices if ``fn`` is a factory returning a donating jit
+    (``return jax.jit(step, donate_argnums=(0,))``)."""
+    if not isinstance(fn, FuncNode):
+        return None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            lit = _donate_literal(node.value)
+            if lit is not None:
+                return lit
+    return None
+
+
+def _resolve_callables(ctx: FileContext, expr: ast.AST,
+                       at_node: ast.AST) -> List[ast.AST]:
+    """Function defs ``expr`` may denote — whole-program when available,
+    file-local lexical fallback otherwise."""
+    if ctx.program is not None:
+        return ctx.program.function_defs_of(ctx.rel_path, expr, at_node)
+    if isinstance(expr, ast.Name):
+        resolved = ctx.traced._resolve(expr.id, at_node)
+        if isinstance(resolved, FuncNode):
+            return [resolved]
+    return []
+
+
+def _source_name(node: ast.AST, settings) -> Optional[str]:
+    """Dotted name of a host-tree-producing call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    if name.startswith("np.") or name.startswith("numpy."):
+        return name
+    if name in _DEVICE_GET:
+        return name
+    if (name in settings.host_tree_sources
+            or name.split(".")[-1] in settings.host_tree_sources):
+        return name
+    return None
+
+
+def _is_device_put(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in _DEVICE_PUT)
+
+
+def _names_in(expr: ast.AST) -> List[str]:
+    return [n.id for n in ast.walk(expr) if isinstance(n, ast.Name)]
+
+
+class _Scope:
+    """One analysis scope: a function body or the module top level."""
+
+    def __init__(self, owner: ast.AST, body_nodes: List[ast.AST]):
+        self.owner = owner
+        self.body_nodes = body_nodes
+
+
+def _scopes(ctx: FileContext) -> Iterator[_Scope]:
+    funcs = [n for n in ast.walk(ctx.tree) if isinstance(n, FuncNode)]
+    # module top level: statements not inside any function
+    top = [n for n in ast.walk(ctx.tree)
+           if ctx.traced.enclosing_function(n) is None]
+    yield _Scope(ctx.tree, top)
+    for fn in funcs:
+        nodes = [n for n in ast.walk(fn) if n is not fn]
+        yield _Scope(fn, nodes)
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    seen = set()  # nested defs appear in their enclosing scope too
+    # call node -> donate indices of its (binding-independent) callee,
+    # shared across scopes: resolution through the program is the
+    # expensive part and a node's callee never changes
+    resolved_cache: Dict[int, Optional[Tuple[int, ...]]] = {}
+    for scope in _scopes(ctx):
+        for f in _check_scope(ctx, scope, resolved_cache):
+            key = (f.line, f.col, f.message)
+            if key not in seen:
+                seen.add(key)
+                yield f
+
+
+def _check_scope(ctx: FileContext, scope: _Scope,
+                 resolved_cache: Dict[int, Optional[Tuple[int, ...]]],
+                 ) -> Iterator[Finding]:
+    # ``name -> donate indices`` for locally-bound donating callables
+    donating: Dict[str, Tuple[int, ...]] = {}
+    tainted: Dict[str, str] = {}  # name -> source description
+
+    events: List[Tuple[int, int, str, ast.AST]] = []
+    for node in scope.body_nodes:
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            events.append((node.lineno, node.col_offset, "assign", node))
+        elif isinstance(node, ast.Call):
+            events.append((node.lineno, node.col_offset, "call", node))
+    # calls sort before assigns at the same line: in `x = step(x, b)` the
+    # RHS call evaluates (and must be judged) before `x` rebinds
+    events.sort(key=lambda e: (e[0], 0 if e[2] == "call" else 1, e[1]))
+
+    def donate_of_callee(call: ast.Call) -> Optional[Tuple[int, ...]]:
+        f = call.func
+        # local bindings are scope-dependent — checked before the cache
+        if isinstance(f, ast.Name) and f.id in donating:
+            return donating[f.id]
+        if id(call) in resolved_cache:
+            return resolved_cache[id(call)]
+        lit = _donate_literal(f)  # jax.jit(g, donate_argnums=..)(x)
+        if lit is None:
+            for target in _resolve_callables(ctx, f, call):
+                lit = _decorated_donate(target)
+                if lit is not None:
+                    break
+        resolved_cache[id(call)] = lit
+        return lit
+
+    for _, _, kind, node in events:
+        if kind == "assign":
+            value = node.value
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            names: List[str] = []
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, ast.Tuple):
+                    names.extend(e.id for e in t.elts
+                                 if isinstance(e, ast.Name))
+            if not names or value is None:
+                continue
+            src = _source_name(value, ctx.settings)
+            if src is not None:
+                for n in names:
+                    tainted[n] = src
+                continue
+            if _is_device_put(value):
+                for n in names:
+                    tainted.pop(n, None)
+                continue
+            # binding a donating callable?
+            if isinstance(value, ast.Call):
+                key = -id(value)  # distinct namespace from callee cache
+                if key in resolved_cache:
+                    lit = resolved_cache[key]
+                else:
+                    lit = _donate_literal(value)
+                    if lit is None:
+                        for target in _resolve_callables(ctx, value.func,
+                                                         node):
+                            lit = _returned_donate(target)
+                            if lit is not None:
+                                break
+                    resolved_cache[key] = lit
+                if lit is not None:
+                    for n in names:
+                        donating[n] = lit
+                    continue
+                # a call result is device-side unless it's a source
+                for n in names:
+                    tainted.pop(n, None)
+                continue
+            # plain data flow: tainted if any referenced name is
+            carried = [tainted[n] for n in _names_in(value)
+                       if n in tainted]
+            for n in names:
+                if carried:
+                    tainted[n] = carried[0]
+                else:
+                    tainted.pop(n, None)
+        else:  # call — is it a donating sink fed a host tree?
+            argnums = donate_of_callee(node)
+            if argnums is None:
+                continue
+            for i in argnums:
+                if i >= len(node.args):
+                    continue
+                arg = node.args[i]
+                src: Optional[str] = None
+                if isinstance(arg, ast.Name) and arg.id in tainted:
+                    src = tainted[arg.id]
+                else:
+                    src = _source_name(arg, ctx.settings)
+                if src is None or _is_device_put(arg):
+                    continue
+                yield ctx.finding(
+                    NAME, node,
+                    f"argument {i} is a host (numpy-backed) tree from "
+                    f"`{src}` donated into a jitted call — XLA reuses "
+                    "the buffer in place and corrupts the host copy "
+                    "(PR 5/7); `jax.device_put` it first")

@@ -11,10 +11,11 @@ either silently re-widens a tensor the policy wanted narrow (perf leak)
 or narrows an island the policy promised stays f32 (numerics leak) —
 and nobody can audit the island set because it is scattered.
 
-Scope: files under ``mx_rcnn_tpu/models/`` only. Model forwards are
-definitionally jit-reachable — train/step.py and evaluation/tester.py
-trace them cross-module, which tracing.py's same-module reachability
-cannot see — so every function in a model module is treated as traced.
+Scope: files under ``mx_rcnn_tpu/models/``, in jit-reachable code only.
+Reachability is graftsight's whole-program closure (callgraph.py): model
+forwards traced from train/step.py and evaluation/tester.py are seen
+cross-module, and genuinely host-side model helpers (checkpoint shape
+inspection, config plumbing) are exempt rather than blanket-flagged.
 Flagged:
 
 - ``<expr>.astype(<float dtype literal>)``;
@@ -89,6 +90,8 @@ def check(ctx: FileContext) -> Iterator[Finding]:
         return
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
+            continue
+        if not ctx.traced.in_traced_code(node):
             continue
         # <expr>.astype(<float literal>) — positional or dtype=keyword
         if (isinstance(node.func, ast.Attribute)

@@ -1,0 +1,214 @@
+"""thread-shared-mutation: unlocked attrs shared with a daemon thread.
+
+The PR 9 `_note_pad` race shape: a counter/dict/flag on an object is
+mutated both from a `threading.Thread` worker and from the main thread,
+with no common lock — increments vanish, dicts resize under iteration,
+and the failure reproduces once a week on a loaded host. The repo's
+long-lived thread owners (StallWatchdog, the loader's prefetch worker,
+the async CheckpointWriter) all follow the same discipline: every
+mutation of cross-thread state happens under `with self._lock` (or a
+Condition, which is a lock plus a waitset).
+
+Per class, the rule:
+
+- finds the thread side: `run()` when the class subclasses
+  `threading.Thread`, plus any method passed as `target=` to a
+  `threading.Thread(...)` constructed in the class, closed transitively
+  over same-class `self.m()` calls;
+- collects every write to `self.<attr>` (plain assign, augmented
+  assign, and `self.<attr>[k] = v` item writes), outside `__init__`
+  (anything before `.start()` is happens-before and uninteresting);
+- knows which attrs are locks: assigned from `threading.Lock()`,
+  `RLock()`, or `Condition()` (instance or class level); a write is
+  "locked" when an enclosing `with self.<lock>:` holds one;
+- flags attrs written on BOTH sides when any of those writes is
+  unlocked — each unlocked write site is a finding.
+
+Attrs written on one side only, fully-locked attrs, and classes that
+never construct a thread are all clean. Dynamic dispatch
+(`getattr(self, name)()` into the thread target) resolves to nothing
+and under-approximates — never over-flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import FuncNode, dotted_name
+
+NAME = "thread-shared-mutation"
+RATIONALE = ("an attribute mutated both inside and outside a "
+             "threading.Thread target without a common `with "
+             "self._lock` is the PR 9 pad-counter race")
+
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+_THREAD_NAMES = {"threading.Thread", "Thread"}
+
+
+def _is_thread_subclass(cls: ast.ClassDef) -> bool:
+    return any(dotted_name(b) in _THREAD_NAMES for b in cls.bases)
+
+
+def _methods_of(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    return {item.name: item for item in cls.body
+            if isinstance(item, FuncNode)}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attrs holding a Lock/RLock/Condition (self.x = ... in any method,
+    or a class-level x = ... default)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func) in _LOCK_FACTORIES):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out.add(t.attr)
+            elif isinstance(t, ast.Name):  # class-level default
+                out.add(t.id)
+    return out
+
+
+def _thread_targets(cls: ast.ClassDef,
+                    methods: Dict[str, ast.AST]) -> Set[str]:
+    """Method names passed as ``target=`` to a Thread constructed
+    anywhere in the class (``threading.Thread(target=self._worker)``)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func) in _THREAD_NAMES):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            if (isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self" and v.attr in methods):
+                out.add(v.attr)
+    return out
+
+
+def _close_thread_side(methods: Dict[str, ast.AST],
+                       seeds: Set[str]) -> Set[str]:
+    """Transitive same-class closure: self.m() from a thread-side
+    method drags m onto the thread side."""
+    side = set(seeds)
+    work = list(seeds)
+    while work:
+        m = methods.get(work.pop())
+        if m is None:
+            continue
+        for node in ast.walk(m):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in methods
+                    and node.func.attr not in side):
+                side.add(node.func.attr)
+                work.append(node.func.attr)
+    return side
+
+
+def _self_attr_written(target: ast.AST) -> Optional[str]:
+    """'attr' when ``target`` writes self.attr (directly or through a
+    subscript: ``self.attr[k] = v`` mutates attr)."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"):
+        return target.attr
+    return None
+
+
+def _is_locked(ctx: FileContext, node: ast.AST,
+               locks: Set[str]) -> bool:
+    """Any enclosing ``with self.<lock>:`` (or bare ``with <lock>:``
+    for class-level locks)."""
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                e = item.context_expr
+                # with self._lock:  /  with self._cond:
+                if (isinstance(e, ast.Attribute)
+                        and isinstance(e.value, ast.Name)
+                        and e.value.id == "self" and e.attr in locks):
+                    return True
+                # with self._lock.acquire_timeout(...) style wrappers
+                if (isinstance(e, ast.Call)
+                        and isinstance(e.func, ast.Attribute)
+                        and isinstance(e.func.value, ast.Attribute)
+                        and isinstance(e.func.value.value, ast.Name)
+                        and e.func.value.value.id == "self"
+                        and e.func.value.attr in locks):
+                    return True
+                if isinstance(e, ast.Name) and e.id in locks:
+                    return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    for cls in ast.walk(ctx.tree):
+        if isinstance(cls, ast.ClassDef):
+            yield from _check_class(ctx, cls)
+
+
+def _check_class(ctx: FileContext,
+                 cls: ast.ClassDef) -> Iterator[Finding]:
+    methods = _methods_of(cls)
+    seeds = _thread_targets(cls, methods)
+    if _is_thread_subclass(cls) and "run" in methods:
+        seeds.add("run")
+    if not seeds:
+        return  # no thread born here — nothing is concurrent
+    thread_side = _close_thread_side(methods, seeds)
+    locks = _lock_attrs(cls)
+
+    # (attr) -> list of (write node, on thread side?, locked?)
+    writes: Dict[str, List[Tuple[ast.AST, bool, bool]]] = {}
+    for mname, m in methods.items():
+        if mname == "__init__":
+            continue  # pre-start writes happen-before the thread
+        on_thread = mname in thread_side
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                attr = _self_attr_written(t)
+                if attr is None or attr in locks:
+                    continue
+                writes.setdefault(attr, []).append(
+                    (node, on_thread, _is_locked(ctx, node, locks)))
+
+    for attr, sites in sorted(writes.items()):
+        both = (any(on for _, on, _ in sites)
+                and any(not on for _, on, _ in sites))
+        if not both:
+            continue
+        unlocked = [(n, on) for n, on, locked in sites if not locked]
+        for node, on_thread in unlocked:
+            where = ("the thread side" if on_thread
+                     else "the main thread")
+            yield ctx.finding(
+                NAME, node,
+                f"`self.{attr}` is written both inside and outside "
+                f"`{cls.name}`'s thread target; this write (on "
+                f"{where}) holds no `with self._lock` — the PR 9 "
+                "pad-counter race shape")

@@ -38,6 +38,11 @@ class Settings:
     state_params: Tuple[str, ...] = ("state", "train_state")
     #: variable names assumed to hold the frozen Config tree
     cfg_roots: Tuple[str, ...] = ("cfg",)
+    #: callables whose result is a host-side (numpy-backed) pytree —
+    #: feeding one into a donating jit without jax.device_put is the
+    #: PR 5/7 heap-corruption family (rules/donation_hazard.py)
+    host_tree_sources: Tuple[str, ...] = (
+        "load_checkpoint", "host_tree_copy")
 
     @staticmethod
     def load(root: str) -> "Settings":
@@ -51,7 +56,8 @@ class Settings:
         for key, attr in (("paths", "paths"), ("exclude", "exclude"),
                           ("disable", "disable"),
                           ("state-params", "state_params"),
-                          ("cfg-roots", "cfg_roots")):
+                          ("cfg-roots", "cfg_roots"),
+                          ("host-tree-sources", "host_tree_sources")):
             if key in tool:
                 kw[attr] = tuple(tool[key])
         if "baseline" in tool:

@@ -2,26 +2,30 @@
 
 Trace roots are functions that are (a) decorated with ``@jax.jit`` /
 ``@jit`` / ``@pjit`` / ``@partial(jax.jit, ...)``, (b) passed as the first
-positional argument to a ``jax.jit(...)`` / ``pjit(...)`` call, or (c)
-passed as the kernel to ``pl.pallas_call(...)``. From the roots the set
-closes transitively over *same-module* calls resolved lexically (enclosing
-function scopes outward to module level) — ``jax.jit(step)`` in
-``train/step.py`` marks ``step``, which marks the sibling closures
-``_one_update`` / ``_grads_of`` and the module-level ``_metric_parts``.
+positional argument to a ``jax.jit(...)`` / ``pjit(...)`` /
+``shard_map(...)`` call, or (c) passed as the kernel to
+``pl.pallas_call(...)``. From the roots the set closes transitively over
+same-module calls resolved lexically (enclosing function scopes outward
+to module level) — ``jax.jit(step)`` in ``train/step.py`` marks ``step``,
+which marks the sibling closures ``_one_update`` / ``_grads_of`` and the
+module-level ``_metric_parts``.
 
-Cross-module calls are NOT followed (no import resolution): a helper in
-``models/`` called only from a jitted wrapper in ``train/`` is invisible
-to the host-sync/shape rules unless its own module jits something. That
-under-approximation is deliberate — it keeps the pass flow-insensitive and
-false-positive-free on host-side helper code, and the conventions the
-linter enforces put the jit boundary and the traced helpers in the same
-module everywhere in this repo.
+Cross-module reachability comes from graftsight (callgraph.py): when the
+engine runs over a whole tree it builds one Program — module-qualified
+symbol resolution over imports, attribute calls and class methods, with
+jit roots propagated transitively across files — and seeds each file's
+TraceAnalysis with the program's traced nodes for that file
+(``extra_traced``). A helper in ``models/`` called only from a jitted
+wrapper in ``train/`` is then just as visible to the host-sync/shape
+rules as a same-module helper. Single-snippet runs (``lint_source`` with
+no program) keep the file-local under-approximation: flow-insensitive
+and false-positive-free on host-side helper code.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
 FuncOrLambda = FuncNode + (ast.Lambda,)
@@ -31,6 +35,8 @@ JIT_CALLABLES = {
     "jit", "jax.jit", "pjit", "jax.pjit", "pjit.pjit",
     "pallas_call", "pl.pallas_call", "pallas.pallas_call",
     "checkify.checkify",
+    "shard_map", "jax.shard_map",
+    "jax.experimental.shard_map.shard_map", "shard_map.shard_map",
 }
 #: of those, the ones with jit's ``donate_argnums`` API (rules/donation.py)
 JIT_DONATABLE = {"jit", "jax.jit", "pjit", "jax.pjit", "pjit.pjit"}
@@ -97,13 +103,19 @@ class _ScopeIndex(ast.NodeVisitor):
 
 
 class TraceAnalysis:
-    def __init__(self, tree: ast.AST, parents: Dict[ast.AST, ast.AST]):
+    def __init__(self, tree: ast.AST, parents: Dict[ast.AST, ast.AST],
+                 extra_traced: Iterable[ast.AST] = ()):
+        """``extra_traced``: function nodes of THIS tree that a
+        whole-program pass (callgraph.Program) proved jit-reachable from
+        roots in other modules; they seed the same-module closure."""
         self.tree = tree
         self.parents = parents
         self._index = _ScopeIndex()
         self._index.visit(tree)
+        self._own_cache: Dict[ast.AST, Dict[str, ast.AST]] = {}
         self.traced: Set[ast.AST] = set()
         self._find_roots()
+        self.traced.update(extra_traced)
         self._close_over_calls()
 
     # -- root discovery ----------------------------------------------------
@@ -145,6 +157,9 @@ class TraceAnalysis:
         return None
 
     def _own_scope(self, fn: ast.AST) -> Dict[str, ast.AST]:
+        cached = self._own_cache.get(fn)
+        if cached is not None:
+            return cached
         out: Dict[str, ast.AST] = {}
         for child in ast.walk(fn):
             if child is fn or not isinstance(child, FuncNode):
@@ -152,6 +167,7 @@ class TraceAnalysis:
             # only defs whose nearest enclosing function is fn
             if self.enclosing_function(child) is fn:
                 out.setdefault(child.name, child)
+        self._own_cache[fn] = out
         return out
 
     # -- transitive closure ------------------------------------------------

@@ -75,7 +75,7 @@ def rpn_losses(
     )
     diff = island(rpn_bbox_deltas - bbox_targets)
     l1 = smooth_l1(diff, sigma=3.0) * bbox_weights
-    bbox_loss = jnp.sum(l1) / float(rpn_batch_size * b)
+    bbox_loss = jnp.sum(l1) / (rpn_batch_size * b)
     return {
         "rpn_cls_loss": cls_loss,
         "rpn_bbox_loss": bbox_loss,
@@ -102,7 +102,7 @@ def rcnn_losses(
     cls_loss, ce, valid = softmax_ce_with_ignore(cls_logits, labels)
     diff = island(bbox_pred - bbox_targets)
     l1 = smooth_l1(diff, sigma=1.0) * bbox_weights
-    bbox_loss = jnp.sum(l1) / float(batch_rois * batch_images)
+    bbox_loss = jnp.sum(l1) / (batch_rois * batch_images)
     return {
         "rcnn_cls_loss": cls_loss,
         "rcnn_bbox_loss": bbox_loss,

@@ -2,8 +2,9 @@
 # graftlint gate — identical invocation locally, in pre-commit, and in any
 # future CI. Exits non-zero on any non-baselined finding or stale baseline
 # entry. Paths/config come from [tool.graftlint] in pyproject.toml; the
-# pre-commit hook runs it repo-wide (pass_filenames: false — cfg-contract
-# and the baseline are global properties). Explicit paths lint a subset.
+# pre-commit hook passes --changed-only (the call graph still spans the
+# whole tree — only the per-file rule pass narrows). --stats prints
+# per-rule finding counts and wall time on every run.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m mx_rcnn_tpu.analysis "$@"
+exec python -m mx_rcnn_tpu.analysis --stats "$@"

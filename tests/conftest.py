@@ -36,6 +36,45 @@ enable_persistent_cache()
 import numpy as np
 import pytest
 
+#: below this much free space in the tmp dir, checkpoint-writing fixtures
+#: skip loudly instead of dying mid-write with a phantom FileNotFoundError
+#: (PR 12's notes: a full /tmp surfaces as missing .npz shards, not ENOSPC)
+_TMP_FREE_FLOOR_BYTES = 512 * 1024 * 1024
+
+
+def _tmp_free_bytes() -> int:
+    import shutil
+    import tempfile
+
+    try:
+        return shutil.disk_usage(tempfile.gettempdir()).free
+    except OSError:
+        return _TMP_FREE_FLOOR_BYTES  # unknowable — don't block the run
+
+
+def _require_tmp_space(what: str):
+    free = _tmp_free_bytes()
+    if free < _TMP_FREE_FLOOR_BYTES:
+        pytest.skip(
+            f"/tmp has only {free // (1024 * 1024)} MiB free "
+            f"(< {_TMP_FREE_FLOOR_BYTES // (1024 * 1024)} MiB floor) — "
+            f"{what} writes checkpoints there and would fail with "
+            "misleading FileNotFoundErrors; free space and re-run")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _prune_run_tmp(tmp_path_factory):
+    """Session finalizer: delete THIS run's pytest tmp tree (checkpoint
+    dirs from the fit baselines and resilience tests are the bulk of it)
+    so repeated runs stop accumulating toward /tmp exhaustion. pytest's
+    own keep-3-runs retention never fires when a run is killed mid-way;
+    this always does."""
+    yield
+    import shutil
+
+    base = tmp_path_factory.getbasetemp()
+    shutil.rmtree(base, ignore_errors=True)
+
 
 @pytest.fixture
 def rng():
@@ -49,6 +88,7 @@ def _uninterrupted_fit(tmp_path_factory, name, **kw):
     import _resilience_driver as driver
     from mx_rcnn_tpu.resilience import chaos
 
+    _require_tmp_space(f"the {name} baseline fit")
     old = os.environ.pop(chaos.ENV_VAR, None)
     chaos.reset()
     try:

@@ -5,17 +5,26 @@ rule's positive/negative contract is pinned independently of the live
 tree's state. The live-tree gate is tests/test_lint_clean.py.
 """
 
+import ast
 import textwrap
 
 import pytest
 
-from mx_rcnn_tpu.analysis import Settings, lint_source
+from mx_rcnn_tpu.analysis import Settings, lint_source, lint_sources
 from mx_rcnn_tpu.analysis.rules import ALL_RULES
 
 
 def lint(src, settings=None):
     return lint_source(textwrap.dedent(src), "snippet.py",
                        settings or Settings(), ALL_RULES)
+
+
+def lint_files(files, settings=None):
+    """Multi-file mini-program: {rel_path: snippet} — reachability closes
+    over ALL files before rules run (graftsight's whole-program path)."""
+    return lint_sources(
+        {path: textwrap.dedent(src) for path, src in files.items()},
+        settings or Settings(), ALL_RULES)
 
 
 def rules_of(findings):
@@ -991,13 +1000,34 @@ def test_time_in_jit_near_miss_unrelated_names():
 # ---------------------------------------------------------------------------
 
 def lint_model(src):
-    """Lint a snippet AS model code (the rule is scoped to
-    mx_rcnn_tpu/models/ — model forwards are jit-reachable cross-module,
-    which same-module tracing cannot see)."""
+    """Lint a snippet AS model code, driven by a jitted entry in a
+    DIFFERENT module — the rule fires only on jit-reachable model code
+    now, so the fixture exercises graftsight's cross-module closure
+    (auto-generating one driver call per top-level def/class)."""
     import textwrap as _tw
 
-    return lint_source(_tw.dedent(src), "mx_rcnn_tpu/models/snippet.py",
-                       Settings(), ALL_RULES)
+    src = _tw.dedent(src)
+    calls = []
+    for item in ast.parse(src).body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            calls.append(f"    snippet.{item.name}(x, x)")
+        elif isinstance(item, ast.ClassDef):
+            calls.append(f"    snippet.{item.name}()(x)")
+    driver = "\n".join([
+        "import jax",
+        "from mx_rcnn_tpu.models import snippet",
+        "",
+        "def _drive(x):",
+    ] + (calls or ["    pass"]) + [
+        "",
+        "run = jax.jit(_drive)",
+    ])
+    findings = lint_files({
+        "mx_rcnn_tpu/models/snippet.py": src,
+        "mx_rcnn_tpu/train/driver.py": driver,
+    })
+    return [f for f in findings
+            if f.path == "mx_rcnn_tpu/models/snippet.py"]
 
 
 def test_dtype_cast_flags_astype_float_literal_in_model_code():
@@ -1210,3 +1240,404 @@ def test_unbarriered_publish_near_miss_unguarded_and_foreign_saves():
                 log.save()
     """)
     assert "unbarriered-publish" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# graftsight: whole-program call graph (callgraph.py)
+# ---------------------------------------------------------------------------
+
+def _traced_names(files, rel_path):
+    """Names of functions in ``rel_path`` the whole-program closure marks
+    traced — the unit probe for callgraph.Program."""
+    import textwrap as _tw
+
+    from mx_rcnn_tpu.analysis import callgraph
+
+    trees = {p: ast.parse(_tw.dedent(s)) for p, s in files.items()}
+    program = callgraph.build_program(trees)
+    return {getattr(n, "name", "<lambda>")
+            for n in program.traced_nodes(rel_path)}
+
+
+def test_callgraph_cross_module_direct_call():
+    """jit root in a.py calls b.helper() — helper is traced in b.py."""
+    names = _traced_names({
+        "pkg/a.py": """
+            import jax
+            from pkg import b
+
+            @jax.jit
+            def entry(x):
+                return b.helper(x)
+        """,
+        "pkg/b.py": """
+            def helper(x):
+                return inner(x)
+
+            def inner(x):
+                return x
+
+            def unrelated(x):
+                return x
+        """,
+    }, "pkg/b.py")
+    assert names == {"helper", "inner"}  # transitively, not unrelated
+
+
+def test_callgraph_aliased_from_import():
+    """`from pkg.b import helper as h` — the alias resolves."""
+    names = _traced_names({
+        "pkg/a.py": """
+            import jax
+            from pkg.b import helper as h
+
+            @jax.jit
+            def entry(x):
+                return h(x)
+        """,
+        "pkg/b.py": """
+            def helper(x):
+                return x
+        """,
+    }, "pkg/b.py")
+    assert names == {"helper"}
+
+
+def test_callgraph_method_on_imported_class():
+    """Constructor assignment types the variable; obj.m() resolves to
+    the imported class's method."""
+    names = _traced_names({
+        "pkg/a.py": """
+            import jax
+            from pkg.b import Model
+
+            @jax.jit
+            def entry(x):
+                m = Model()
+                return m.forward(x)
+        """,
+        "pkg/b.py": """
+            class Model:
+                def forward(self, x):
+                    return self._head(x)
+
+                def _head(self, x):
+                    return x
+
+                def save(self, path):
+                    pass
+        """,
+    }, "pkg/b.py")
+    assert names == {"forward", "_head"}  # self._head chased, save not
+
+
+def test_callgraph_cycle_between_modules_terminates():
+    """a.f -> b.g -> a.f again: the closure must terminate and mark
+    both, not loop."""
+    names_a = _traced_names({
+        "pkg/a.py": """
+            import jax
+            from pkg import b
+
+            @jax.jit
+            def f(x, depth):
+                return b.g(x, depth)
+        """,
+        "pkg/b.py": """
+            from pkg import a
+
+            def g(x, depth):
+                return a.f(x, depth - 1)
+        """,
+    }, "pkg/b.py")
+    assert names_a == {"g"}
+
+
+def test_callgraph_unresolvable_dynamic_call_degrades():
+    """getattr-dispatch and call-result callables resolve to nothing:
+    no crash, and the dynamically-named function stays NOT traced
+    (under-approximation, never over-flagging)."""
+    names = _traced_names({
+        "pkg/a.py": """
+            import jax
+            from pkg import b
+
+            @jax.jit
+            def entry(x, which):
+                fn = getattr(b, which)
+                g = b.make()()
+                return fn(x) + g
+        """,
+        "pkg/b.py": """
+            def maybe_target(x):
+                return x
+
+            def make():
+                def inner():
+                    return 0
+                return inner
+        """,
+    }, "pkg/b.py")
+    assert "maybe_target" not in names
+    assert "inner" not in names  # call-result indirection: unresolvable
+    assert "make" in names  # b.make() itself IS called directly
+
+
+def test_cross_module_host_sync_fires_through_the_program():
+    """Acceptance gate: a pre-existing jit rule (host-sync-in-jit) whose
+    root and violation live in DIFFERENT modules — file-local tracing
+    cannot see it; graftsight must."""
+    files = {
+        "pkg/train.py": """
+            import jax
+            from pkg import ops
+
+            def step(state, x):
+                return ops.normalize(state, x)
+
+            run = jax.jit(step)
+        """,
+        "pkg/ops.py": """
+            def normalize(state, x):
+                scale = float(x.sum())   # host sync inside traced code
+                return state, x / scale
+        """,
+    }
+    findings = lint_files(files)
+    assert any(f.rule == "host-sync-in-jit"
+               and f.path == "pkg/ops.py" for f in findings)
+    # and the same file linted ALONE (no program) stays clean — the
+    # finding exists only through whole-program reachability
+    alone = lint_source(textwrap.dedent(files["pkg/ops.py"]),
+                        "pkg/ops.py", Settings(), ALL_RULES)
+    assert "host-sync-in-jit" not in rules_of(alone)
+
+
+# ---------------------------------------------------------------------------
+# donation-hazard
+# ---------------------------------------------------------------------------
+
+def test_donation_hazard_flags_device_get_tree_into_local_donating_jit():
+    findings = lint("""
+        import jax
+
+        def resume(step_fn, batch):
+            run = jax.jit(step_fn, donate_argnums=(0,))
+            state = jax.device_get(batch)      # host tree
+            return run(state, batch)
+    """)
+    assert "donation-hazard" in rules_of(findings)
+
+
+def test_donation_hazard_flags_np_tree_and_checkpoint_restore():
+    findings = lint("""
+        import jax
+        import numpy as np
+        from mx_rcnn_tpu.train.checkpoint import load_checkpoint
+
+        def restore_and_go(step, path, batch):
+            run = jax.jit(step, donate_argnums=(0, 1))
+            state = load_checkpoint(path)        # configured source
+            opt = np.zeros((4,))                 # np.* source
+            return run(state, opt, batch)
+    """)
+    assert sum(f.rule == "donation-hazard" for f in findings) == 2
+
+
+def test_donation_hazard_flags_cross_module_step_factory():
+    """The PR 5/7 shape end-to-end: a restore flows into a donating
+    step built by an IMPORTED factory (make_train_step's literal
+    donate_argnums form)."""
+    findings = lint_files({
+        "pkg/steps.py": """
+            import jax
+
+            def make_step(model):
+                def step(state, batch):
+                    return state
+                return jax.jit(step, donate_argnums=(0,))
+        """,
+        "pkg/fit.py": """
+            from pkg.steps import make_step
+            from mx_rcnn_tpu.train.checkpoint import load_checkpoint
+
+            def fit(model, path, batch):
+                step = make_step(model)
+                state = load_checkpoint(path)
+                return step(state, batch)
+        """,
+    })
+    assert any(f.rule == "donation-hazard"
+               and f.path == "pkg/fit.py" for f in findings)
+
+
+def test_donation_hazard_near_miss_device_put_cleanses():
+    findings = lint("""
+        import jax
+        from mx_rcnn_tpu.train.checkpoint import load_checkpoint
+
+        def resume(step_fn, path, batch):
+            run = jax.jit(step_fn, donate_argnums=(0,))
+            state = load_checkpoint(path)
+            state = jax.device_put(state)      # cleanse
+            return run(state, batch)
+    """)
+    assert "donation-hazard" not in rules_of(findings)
+
+
+def test_donation_hazard_near_miss_conditional_donate_is_unresolvable():
+    """The sanctioned fit_detector CPU path: `donate_argnums=(0,) if
+    donate else ()` is not a statically-donating call — no finding even
+    with a host tree flowing in (on CPU the factory disables donation;
+    flagging it would force a pointless device_put)."""
+    findings = lint("""
+        import jax
+        from mx_rcnn_tpu.train.checkpoint import load_checkpoint
+
+        def fit(step_fn, path, batch):
+            donate = jax.default_backend() != "cpu"
+            run = jax.jit(step_fn,
+                          donate_argnums=(0,) if donate else ())
+            state = load_checkpoint(path)
+            return run(state, batch)
+    """)
+    assert "donation-hazard" not in rules_of(findings)
+
+
+def test_donation_hazard_near_miss_rebind_from_sink_output():
+    """state = run(state, b): AFTER the first (flagged) call the name is
+    device-side — the steady-state loop does not re-flag every step."""
+    findings = lint("""
+        import jax
+        import numpy as np
+
+        def loop(step_fn, batches):
+            run = jax.jit(step_fn, donate_argnums=(0,))
+            state = np.zeros((4,))
+            for b in batches:
+                state = run(state, b)
+            return state
+    """)
+    assert sum(f.rule == "donation-hazard" for f in findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# thread-shared-mutation
+# ---------------------------------------------------------------------------
+
+def test_thread_race_flags_unlocked_counter_both_sides():
+    """The PR 9 _note_pad shape: a counter bumped by the worker and
+    reset by the main thread, no lock anywhere."""
+    findings = lint("""
+        import threading
+
+        class Watch:
+            def __init__(self):
+                self._n = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                self._n += 1
+
+            def reset(self):
+                self._n = 0
+    """)
+    assert sum(f.rule == "thread-shared-mutation" for f in findings) == 2
+
+
+def test_thread_race_flags_one_unlocked_side_and_subscript_write():
+    """Locking only ONE side is still a race; dict item writes count as
+    writes to the attr."""
+    findings = lint("""
+        import threading
+
+        class Recorder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._events = {}
+                threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                with self._lock:
+                    self._events["beat"] = 1   # locked: fine
+
+            def clear(self):
+                self._events["beat"] = 0       # unlocked main-side write
+    """)
+    flagged = [f for f in findings if f.rule == "thread-shared-mutation"]
+    assert len(flagged) == 1
+    # the clear() write, not the worker's locked one
+    assert flagged[0].text.startswith('self._events["beat"] = 0')
+
+
+def test_thread_race_flags_thread_subclass_run_and_transitive_callee():
+    """Thread-subclass run() seeds the thread side, and self.m() calls
+    from it drag the callee along."""
+    findings = lint("""
+        import threading
+
+        class Pump(threading.Thread):
+            def run(self):
+                self._tick()
+
+            def _tick(self):
+                self.count = self.count + 1
+
+            def restart(self):
+                self.count = 0
+    """)
+    assert sum(f.rule == "thread-shared-mutation" for f in findings) == 2
+
+
+def test_thread_race_near_miss_locked_both_sides_and_condition():
+    """The repo's discipline (StallWatchdog / _PrefetchIterator): every
+    cross-thread write under self._lock or a Condition — clean."""
+    findings = lint("""
+        import threading
+
+        class Clean:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition()
+                self._n = 0
+                self._slots = {}
+                threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                with self._lock:
+                    self._n += 1
+                with self._cond:
+                    self._slots[0] = 1
+
+            def reset(self):
+                with self._lock:
+                    self._n = 0
+                with self._cond:
+                    self._slots.clear()
+                    self._slots[0] = 0
+    """)
+    assert "thread-shared-mutation" not in rules_of(findings)
+
+
+def test_thread_race_near_miss_init_writes_and_threadless_class():
+    """__init__ writes happen-before start() (never flagged), and a
+    class that constructs no thread is out of scope entirely."""
+    findings = lint("""
+        import threading
+
+        class Lazy:
+            def __init__(self):
+                self._n = 0          # pre-start: happens-before
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                pass
+
+        class NoThread:
+            def bump(self):
+                self._n += 1
+
+            def also_bump(self):
+                self._n += 2
+    """)
+    assert "thread-shared-mutation" not in rules_of(findings)

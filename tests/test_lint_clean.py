@@ -42,6 +42,32 @@ def test_baseline_has_no_stale_entries():
         f"prune them: {matcher.unused()}")
 
 
+def test_baseline_entries_point_at_real_code():
+    """Baseline rot fails loudly: every entry's file must still exist and
+    its pinned source-line text must still appear in that file. (The
+    matcher-based staleness test above needs a full lint run; this one
+    catches rot even for entries whose rule was disabled or whose file
+    was deleted/moved — shapes the matcher never exercises.)"""
+    settings = Settings.load(REPO_ROOT)
+    entries = baseline_mod.load(os.path.join(REPO_ROOT, settings.baseline))
+    rotten = []
+    for e in entries:
+        path = os.path.join(REPO_ROOT, e["path"])
+        if not os.path.isfile(path):
+            rotten.append(f"{e['path']}: file no longer exists "
+                          f"(rule {e['rule']})")
+            continue
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = {ln.strip() for ln in fh}
+        if e["text"] not in lines:
+            rotten.append(f"{e['path']}: no line matches {e['text']!r} "
+                          f"(rule {e['rule']})")
+    assert not rotten, (
+        "baseline entries pointing at code that no longer exists — "
+        "regenerate with `python -m mx_rcnn_tpu.analysis "
+        "--write-baseline`:\n" + "\n".join(rotten))
+
+
 def test_cli_exits_zero_on_live_tree():
     proc = subprocess.run(
         [sys.executable, "-m", "mx_rcnn_tpu.analysis",
