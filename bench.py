@@ -687,7 +687,11 @@ def main():
 
     compile_track.deactivate()
     elog.close()
-    summary = obs_report.summarize(obs_report.load_events(elog.path))
+    # Fold the run DIR, not just this process's stream: a multi-host
+    # bench leaves one events_p<k>.jsonl per host, and the blob should
+    # summarize all of them (grafttower fleet_* aggregates ride in via
+    # bench_blob when a --fleet fold adds them).
+    summary = obs_report.summarize(obs_report.load_events(obs_dir))
     report_path = os.path.join(obs_dir, "report.json")
     with open(report_path, "w", encoding="utf-8") as fh:
         # the BENCH-compatible blob (top-level value/compile_count/...,

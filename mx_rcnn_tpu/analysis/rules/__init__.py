@@ -21,6 +21,7 @@ from mx_rcnn_tpu.analysis.rules import (
     thread_race,
     time_in_jit,
     unbarriered_publish,
+    wall_time_duration,
 )
 
 ALL_RULES = (
@@ -40,6 +41,7 @@ ALL_RULES = (
     health_pull,
     thread_race,
     unbarriered_publish,
+    wall_time_duration,
 )
 
 __all__ = ["ALL_RULES"]

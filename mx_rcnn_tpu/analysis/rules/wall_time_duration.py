@@ -1,0 +1,122 @@
+"""wall-time-duration: durations computed from wall-clock samples.
+
+``time.time()`` answers "what o'clock is it" — it steps whenever NTP
+corrects the host clock, by whole seconds on a preemptible fleet that
+just woke up. Subtracting two wall samples therefore measures the clock's
+drift as much as the code's elapsed time; on a multi-host run the skew is
+per-host, which is exactly the bug family the grafttower clock anchor
+(obs/fleet.py) exists to cancel. Durations belong on the monotonic clock
+(``time.monotonic()`` / ``time.perf_counter()``); wall stamps are for
+correlation and display only.
+
+Flags a subtraction where BOTH operands are wall samples:
+
+- a direct ``time.time()`` / ``time.time_ns()`` call (dotted or bound by
+  ``from time import time``),
+- a name or attribute assigned from such a call anywhere in the file
+  (``t0 = time.time()`` … ``time.time() - t0``; ``self._tic``),
+- a ``t_wall`` record field (``e["t_wall"]``, ``e.get("t_wall")``,
+  ``e.t_wall`` — the graftscope event stamp).
+
+Monotonic/perf_counter subtractions, comparisons, max/min over stamps,
+and mixed expressions with an unknown side stay legal — the rule only
+fires when both sides are provably wall time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.tracing import dotted_name
+
+NAME = "wall-time-duration"
+RATIONALE = ("subtracting time.time()/t_wall samples measures NTP drift "
+             "along with elapsed time — durations belong on "
+             "time.monotonic()/perf_counter(); wall stamps are for "
+             "cross-host correlation only")
+
+#: the wall clocks (monotonic/perf_counter are the fix, not the bug)
+_WALL_DOTTED = frozenset({"time.time", "time.time_ns"})
+_WALL_BARE = frozenset({"time", "time_ns"})
+_FIELD = "t_wall"
+
+
+def _from_time_imports(tree: ast.AST) -> frozenset:
+    """Local names bound to the wall clock via ``from time import time``
+    (including aliases)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in _WALL_BARE:
+                    names.add(alias.asname or alias.name)
+    return frozenset(names)
+
+
+def _is_wall_call(node: ast.AST, bare: frozenset) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    if dotted_name(node.func) in _WALL_DOTTED:
+        return True
+    return isinstance(node.func, ast.Name) and node.func.id in bare
+
+
+def _wall_bindings(tree: ast.AST, bare: frozenset):
+    """Names and attribute fields assigned from a wall-clock call
+    anywhere in the file (file-scope heuristic — good enough: a name
+    that EVER holds a wall stamp being subtracted is the bug)."""
+    names, attrs = set(), set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        value = getattr(node, "value", None)
+        if value is None or not _is_wall_call(value, bare):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                attrs.add(tgt.attr)
+    return frozenset(names), frozenset(attrs)
+
+
+def _is_wall_sample(node: ast.AST, bare: frozenset, names: frozenset,
+                    attrs: frozenset) -> bool:
+    """Is this expression provably a wall-clock sample?"""
+    if _is_wall_call(node, bare):
+        return True
+    if isinstance(node, ast.Name) and node.id in names:
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr == _FIELD or node.attr in attrs
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        return isinstance(sl, ast.Constant) and sl.value == _FIELD
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get" and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == _FIELD):
+        return True  # e.get("t_wall")
+    return False
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    bare = _from_time_imports(ctx.tree)
+    names, attrs = _wall_bindings(ctx.tree, bare)
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)):
+            continue
+        if (_is_wall_sample(node.left, bare, names, attrs)
+                and _is_wall_sample(node.right, bare, names, attrs)):
+            yield ctx.finding(
+                NAME, node,
+                "duration computed by subtracting wall-clock samples "
+                "(time.time()/t_wall) — an NTP step lands in the result; "
+                "use time.monotonic()/perf_counter() for durations and "
+                "keep wall stamps for correlation")

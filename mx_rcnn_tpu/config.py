@@ -389,6 +389,14 @@ class ObsConfig:
     stall_factor: float = 10.0
     stall_min_s: float = 120.0
     watchdog_poll_s: float = 5.0
+    # grafttower (obs/fleet.py): liveness beacon cadence — the watchdog
+    # thread additionally emits a `heartbeat` event every this many
+    # seconds (flushed immediately; ring-buffered into the flight
+    # recorder) plus one final=True beat at clean shutdown, so the fleet
+    # report tells a KILLED host (stale trail, no final beat) from a
+    # slow one (fresh beats, fat step tail). 0 disables. Requires
+    # obs.watchdog (the beacon shares its daemon thread).
+    heartbeat_every_s: float = 15.0
     # graftprof (obs/costs.py): per-compiled-shape-bucket XLA cost/memory
     # accounting — one `cost` event per bucket (flops, HBM split), the
     # basis of the computed MFU in step/bench reports. Costs one AOT

@@ -78,7 +78,7 @@ def obs_from_config(cfg, default_dir: str = ""):
     if not directory:
         raise ValueError(
             "obs.enabled=true needs obs.dir (or a caller-provided run "
-            "directory) to place events.jsonl")
+            "directory) to place this process's events_p<k>.jsonl")
     try:
         # Coordination identity, not raw jax: under the graftquorum
         # simulated-host tests each CPU process stamps (and names its

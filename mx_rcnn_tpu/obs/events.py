@@ -75,6 +75,17 @@ EVENT_TYPES = (
                    # agreed boundary or topology (resilience/quorum.py
                    # via tools/train.py; the process stamp says which
                    # host's view this record is)
+    "heartbeat",   # grafttower: cadenced liveness beacon from the
+                   # watchdog thread (obs.heartbeat_every_s) — beat_age_s
+                   # since the last completed step, stall count, and
+                   # final=True exactly once at clean shutdown; a host
+                   # whose stream ends with a STALE non-final heartbeat
+                   # was killed, not slow (obs/watchdog.py, obs/fleet.py)
+    "barrier",     # grafttower: one quorum barrier from THIS host's
+                   # view — name, per-host wait_s, arrival order, who
+                   # arrived last, timed_out (resilience/quorum.py; the
+                   # fleet fold attributes everyone's wait to the last
+                   # arriver)
 )
 
 #: Buffered kinds — everything else flushes to disk immediately, so the
@@ -201,11 +212,12 @@ class EventLog:
 
 
 def event_log_path(directory: str, process_index: int = 0) -> str:
-    """events.jsonl for process 0; events.<i>.jsonl for the others (one
-    file per process — JSONL appends from multiple writers interleave)."""
-    name = ("events.jsonl" if process_index == 0
-            else f"events.{process_index}.jsonl")
-    return os.path.join(directory, name)
+    """events_p<k>.jsonl — one stream per process (JSONL appends from
+    multiple writers interleave), including process 0: on a fleet every
+    host's stream is a peer input to the grafttower merge, not a special
+    case. report.py::load_events also folds the pre-grafttower names
+    (events.jsonl / events.<i>.jsonl) so old run dirs stay readable."""
+    return os.path.join(directory, f"events_p{process_index}.jsonl")
 
 
 def open_event_log(directory: str, process_index: int = 0,

@@ -1,0 +1,354 @@
+"""grafttower — fleet-scope fold over per-host graftscope streams.
+
+Every host of a fleet writes its own ``events_p<k>.jsonl`` (obs/events.py)
+stamped with both wall time (``t_wall``) and monotonic time (``t_mono``).
+Wall clocks across hosts drift (NTP steps of whole seconds are routine on
+preemptible fleets), so sorting the union by ``t_wall`` interleaves the
+streams in the order the *clocks* claim, not the order the fleet ran.
+This module rebuilds one trustworthy fleet timeline and folds it into the
+numbers OUTAGES triage needs: who is slow, who is hung, and whose tail
+everyone else's barrier wait is paying for.
+
+Alignment is two-stage:
+
+1. **Clock anchor.** Each stream's ``run_meta`` record carries the pair
+   (t_wall, t_mono) sampled in one emit — the host's own anchor. Every
+   record is projected onto ``t_fleet = anchor_wall + (t_mono -
+   anchor_mono)``: durations come from the monotonic clock (immune to NTP
+   steps *during* the run), the anchor only places the origin.
+2. **Residual skew.** The anchors themselves inherit each host's wall
+   offset. ``barrier`` events are the correction signal: a quorum barrier
+   releases every host within one poll interval of the same true instant,
+   so the per-host median of (own barrier t_fleet − reference host's
+   barrier t_fleet) over shared barriers IS the residual offset, and is
+   subtracted out. No shared barriers → anchors stand as-is.
+
+stdlib-only, like the rest of the report chain: a run dir scp'd off a pod
+folds on any machine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+#: A heartbeat older than this many emission intervals at end-of-stream
+#: (with no ``final`` beat) reads as a killed host, not a slow one. Two
+#: intervals tolerate one missed emission under scheduler pressure.
+STALE_HEARTBEATS = 2.0
+
+
+def split_hosts(events: Iterable[Dict[str, Any]]
+                ) -> Dict[int, List[Dict[str, Any]]]:
+    """Group a folded event list back into per-host streams by the
+    ``process`` stamp every record carries."""
+    hosts: Dict[int, List[Dict[str, Any]]] = {}
+    for e in events:
+        hosts.setdefault(int(e.get("process", 0)), []).append(e)
+    return hosts
+
+
+def _anchor(stream: List[Dict[str, Any]]
+            ) -> Optional[Tuple[float, float]]:
+    """The stream's (t_wall, t_mono) clock anchor: its first record with
+    both stamps — normally ``run_meta``, but any record works (the pair
+    is sampled in one emit either way)."""
+    for e in stream:
+        if "t_wall" in e and "t_mono" in e:
+            return float(e["t_wall"]), float(e["t_mono"])
+    return None
+
+
+def _project(stream: List[Dict[str, Any]]) -> None:
+    """Stamp ``t_fleet`` onto every record of one host stream (in place):
+    the anchor's wall origin plus the record's monotonic offset from the
+    anchor. Records missing ``t_mono`` (foreign/hand-edited lines) fall
+    back to their wall stamp."""
+    anchor = _anchor(stream)
+    for e in stream:
+        if anchor is not None and "t_mono" in e:
+            anchor_wall, anchor_mono = anchor
+            e["t_fleet"] = anchor_wall + (float(e["t_mono"]) - anchor_mono)
+        else:
+            e["t_fleet"] = float(e.get("t_wall", 0.0))
+
+
+def _barrier_marks(stream: List[Dict[str, Any]]) -> Dict[str, float]:
+    """name → this host's ``t_fleet`` at each barrier release (the
+    residual-skew correction signal; first release wins per name)."""
+    marks: Dict[str, float] = {}
+    for e in stream:
+        if e.get("type") == "barrier" and e.get("name"):
+            marks.setdefault(str(e["name"]), float(e["t_fleet"]))
+    return marks
+
+
+def _median(vals: List[float]) -> float:
+    vals = sorted(vals)
+    mid = len(vals) // 2
+    if len(vals) % 2:
+        return vals[mid]
+    return (vals[mid - 1] + vals[mid]) / 2.0
+
+
+def merge_streams(hosts: Dict[int, List[Dict[str, Any]]]
+                  ) -> List[Dict[str, Any]]:
+    """Align every host stream onto one fleet timeline (module docstring)
+    and return the union sorted by ``t_fleet``. Mutates the records:
+    each gains ``t_fleet``; the per-host corrections applied are left in
+    ``fleet_offsets`` on the (lowest-host) ``run_meta`` record so reports
+    can say how skewed the clocks were."""
+    if not hosts:
+        return []
+    for stream in hosts.values():
+        _project(stream)
+    ref = min(hosts)
+    ref_marks = _barrier_marks(hosts[ref])
+    offsets: Dict[int, float] = {ref: 0.0}
+    for idx, stream in hosts.items():
+        if idx == ref:
+            continue
+        deltas = [marks_tf - ref_marks[name]
+                  for name, marks_tf in _barrier_marks(stream).items()
+                  if name in ref_marks]
+        offsets[idx] = _median(deltas) if deltas else 0.0
+        if offsets[idx]:
+            for e in stream:
+                e["t_fleet"] -= offsets[idx]
+    merged = [e for stream in hosts.values() for e in stream]
+    merged.sort(key=lambda e: e.get("t_fleet", 0.0))
+    for e in merged:
+        if e.get("type") == "run_meta" and int(e.get("process", 0)) == ref:
+            e["fleet_offsets"] = {str(i): round(off, 3)
+                                  for i, off in sorted(offsets.items())}
+            break
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# the fold
+# ---------------------------------------------------------------------------
+
+def _percentile(sorted_vals: List[float], pct: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(pct / 100.0 * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _step_skew(hosts: Dict[int, List[Dict[str, Any]]]
+               ) -> Tuple[List[float], Dict[int, float]]:
+    """Per-dispatch cross-host completion skew from StepTimer events.
+
+    Every host dispatches the same (epoch, batch) sequence (SPMD), so the
+    spread of ``t_fleet`` at which the hosts complete one dispatch is the
+    fleet's lockstep error — and each host's lateness (its completion
+    minus the earliest host's) summed over shared dispatches is the
+    straggler metric: seconds of fleet time this host ran behind."""
+    marks: Dict[Tuple[int, int], Dict[int, float]] = {}
+    for idx, stream in hosts.items():
+        for e in stream:
+            if e.get("type") != "step" or "step_ms" not in e:
+                continue
+            key = (int(e.get("epoch", -1)), int(e.get("batch", -1)))
+            marks.setdefault(key, {})[idx] = float(e["t_fleet"])
+    skews: List[float] = []
+    lateness: Dict[int, float] = {idx: 0.0 for idx in hosts}
+    for per_host in marks.values():
+        if len(per_host) < 2:
+            continue
+        first = min(per_host.values())
+        skews.append(max(per_host.values()) - first)
+        for idx, tf in per_host.items():
+            lateness[idx] += tf - first
+    return sorted(skews), lateness
+
+
+def _fold_barriers(hosts: Dict[int, List[Dict[str, Any]]]
+                   ) -> Dict[str, Any]:
+    """Barrier accounting with wait attribution: at each barrier the
+    waiters' wait_s is owed by the LAST arriver (every barrier event
+    names it from the shared arrival stamps, so all host views agree)."""
+    rounds: Dict[str, Dict[str, Any]] = {}
+    for idx, stream in hosts.items():
+        for e in stream:
+            if e.get("type") != "barrier":
+                continue
+            name = str(e.get("name"))
+            r = rounds.setdefault(name, {"name": name, "wait_s": {},
+                                         "last": None, "timed_out": False})
+            r["wait_s"][idx] = float(e.get("wait_s", 0.0))
+            if e.get("last") is not None:
+                r["last"] = int(e["last"])
+            r["timed_out"] = r["timed_out"] or bool(e.get("timed_out"))
+    owed: Dict[int, float] = {idx: 0.0 for idx in hosts}
+    total_wait = 0.0
+    for r in rounds.values():
+        total_wait += sum(r["wait_s"].values())
+        last = r["last"]
+        if last is None:
+            continue
+        r["owed_s"] = round(sum(w for idx, w in r["wait_s"].items()
+                                if idx != last), 3)
+        owed.setdefault(last, 0.0)
+        owed[last] += r["owed_s"]
+    return {
+        "rounds": len(rounds),
+        "timed_out": sorted(n for n, r in rounds.items() if r["timed_out"]),
+        "total_wait_s": round(total_wait, 3),
+        "owed_s": {idx: round(s, 3) for idx, s in owed.items()},
+        "worst": max(rounds.values(),
+                     key=lambda r: r.get("owed_s", 0.0))["name"]
+                 if rounds else None,
+    }
+
+
+def _fold_heartbeats(hosts: Dict[int, List[Dict[str, Any]]],
+                     fleet_end: float) -> Dict[int, Dict[str, Any]]:
+    """Per-host liveness verdict from the heartbeat trail (module
+    docstring of obs/watchdog.py): ``clean`` = a final beat was emitted
+    (orderly shutdown), ``hung`` = the trail goes stale before the fleet
+    ended with no final beat (SIGKILL skips every finally), ``live`` =
+    fresh beats to the end (slow-but-alive reads as live + a fat step
+    tail in the straggler ranking), ``no-heartbeats`` = the knob was off."""
+    out: Dict[int, Dict[str, Any]] = {}
+    for idx, stream in hosts.items():
+        beats = [e for e in stream if e.get("type") == "heartbeat"]
+        if not beats:
+            out[idx] = {"status": "no-heartbeats", "beats": 0,
+                        "age_s": None, "final": False}
+            continue
+        last = beats[-1]
+        final = any(e.get("final") for e in beats)
+        age = fleet_end - float(last["t_fleet"])
+        every = float(last.get("every_s") or 0.0)
+        if final:
+            status = "clean"
+        elif every and age > STALE_HEARTBEATS * every:
+            status = "hung"
+        else:
+            status = "live"
+        out[idx] = {"status": status, "beats": len(beats),
+                    "age_s": round(age, 3), "final": final,
+                    "every_s": every,
+                    "last_beat_age_s": last.get("beat_age_s")}
+    return out
+
+
+def _timeline(merged: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The coordination-plane narrative: every quorum / heal / preempt /
+    barrier / stall / anomaly / crash record on the fleet clock, relative
+    to the first merged record."""
+    kinds = ("quorum", "heal", "preempt", "barrier", "stall", "anomaly",
+             "crash", "backend_retry", "backend_up")
+    t0 = merged[0]["t_fleet"] if merged else 0.0
+    rows = []
+    for e in merged:
+        if e.get("type") not in kinds:
+            continue
+        rows.append({
+            "t_s": round(float(e["t_fleet"]) - t0, 3),
+            "host": int(e.get("process", 0)),
+            "type": e.get("type"),
+            "what": e.get("name") or e.get("kind") or e.get("reason")
+                    or e.get("error") or "",
+        })
+    return rows
+
+
+def fleet_summary(hosts: Dict[int, List[Dict[str, Any]]]
+                  ) -> Dict[str, Any]:
+    """Fold per-host streams into the fleet report dict. Calls
+    merge_streams itself (idempotent when already merged): the summary
+    always speaks fleet time."""
+    merged = merge_streams(hosts)
+    fleet_end = merged[-1]["t_fleet"] if merged else 0.0
+    skews, lateness = _step_skew(hosts)
+    barriers = _fold_barriers(hosts)
+    heartbeats = _fold_heartbeats(hosts, fleet_end)
+
+    per_host: Dict[int, Dict[str, Any]] = {}
+    for idx, stream in sorted(hosts.items()):
+        step_ms = sorted(float(e["step_ms"]) for e in stream
+                         if e.get("type") == "step" and "step_ms" in e)
+        wait_ms = sorted(float(e.get("data_wait_ms", 0.0)) for e in stream
+                         if e.get("type") == "step" and "step_ms" in e)
+        per_host[idx] = {
+            "steps": len(step_ms),
+            "step_ms_p50": round(_percentile(step_ms, 50), 3),
+            "step_ms_p90": round(_percentile(step_ms, 90), 3),
+            "data_wait_ms_p50": round(_percentile(wait_ms, 50), 3),
+            "lateness_s": round(lateness.get(idx, 0.0), 3),
+            "barrier_wait_owed_s": barriers["owed_s"].get(idx, 0.0),
+            "heartbeat": heartbeats.get(idx),
+        }
+
+    # Straggler ranking: accumulated lateness first (the direct "who ran
+    # behind" signal), barrier wait owed as the tie-breaker — a host can
+    # be late without a barrier in sight, but owing barrier wait without
+    # lateness means the skew hid between step events.
+    ranking = sorted(
+        per_host,
+        key=lambda i: (per_host[i]["lateness_s"],
+                       per_host[i]["barrier_wait_owed_s"]),
+        reverse=True)
+    anchor_meta = next((e for e in merged if "fleet_offsets" in e), None)
+    return {
+        "hosts": sorted(hosts),
+        "offsets_s": (anchor_meta or {}).get("fleet_offsets", {}),
+        "per_host": per_host,
+        "skew": {
+            "dispatches": len(skews),
+            "p50_s": round(_percentile(skews, 50), 4),
+            "p90_s": round(_percentile(skews, 90), 4),
+            "max_s": round(skews[-1], 4) if skews else 0.0,
+        },
+        "straggler_ranking": ranking,
+        "straggler": ranking[0] if len(ranking) > 1 else None,
+        "barriers": barriers,
+        "hung": sorted(i for i, h in heartbeats.items()
+                       if h["status"] == "hung"),
+        "timeline": _timeline(merged),
+    }
+
+
+def render_fleet(fs: Dict[str, Any]) -> str:
+    """Human rendering of a fleet summary — the straggler table OUTAGES'
+    "which host is the problem?" runbook reads top to bottom."""
+    sk = fs["skew"]
+    lines = [
+        "grafttower fleet report",
+        f"  hosts:      {len(fs['hosts'])} stream(s) merged"
+        + (f" | clock offsets(s) {fs['offsets_s']}" if fs["offsets_s"]
+           else ""),
+        f"  step skew:  p50 {sk['p50_s']}s, p90 {sk['p90_s']}s, max "
+        f"{sk['max_s']}s over {sk['dispatches']} shared dispatch(es)",
+        f"  barriers:   {fs['barriers']['rounds']} round(s), "
+        f"{fs['barriers']['total_wait_s']}s total wait"
+        + (f", worst: {fs['barriers']['worst']}"
+           if fs["barriers"]["worst"] else "")
+        + (f", TIMED OUT: {fs['barriers']['timed_out']}"
+           if fs["barriers"]["timed_out"] else ""),
+        "  straggler table (worst first):",
+        "    host  steps  step_ms_p50  lateness_s  barrier_owed_s  "
+        "heartbeat",
+    ]
+    for idx in fs["straggler_ranking"]:
+        h = fs["per_host"][idx]
+        hb = h["heartbeat"] or {}
+        hb_txt = hb.get("status", "-")
+        if hb.get("age_s") is not None:
+            hb_txt += f" (age {hb['age_s']}s)"
+        lines.append(
+            f"    {idx:<4}  {h['steps']:<5}  {h['step_ms_p50']:<11}  "
+            f"{h['lateness_s']:<10}  {h['barrier_wait_owed_s']:<14}  "
+            f"{hb_txt}")
+    if fs["straggler"] is not None:
+        lines.append(f"  straggler:  host {fs['straggler']}")
+    if fs["hung"]:
+        lines.append(f"  HUNG:       host(s) {fs['hung']} — stale "
+                     "heartbeat with no final beat (killed, not slow)")
+    for row in fs["timeline"]:
+        lines.append(f"    +{row['t_s']:>8.3f}s [h{row['host']}] "
+                     f"{row['type']}: {row['what']}")
+    return "\n".join(lines)

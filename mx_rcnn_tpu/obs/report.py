@@ -21,45 +21,72 @@ from typing import Any, Dict, List, Optional
 def load_jsonl_tolerant(path: str, hint: str = "run") -> List[Dict[str, Any]]:
     """Parse a JSONL file whose appends can race a kill: an unparseable
     line — the normal signature of SIGKILL mid-append — is skipped WITH
-    a stderr warning (a silently half-read stream would fold a killed
-    run into a clean-looking artifact), never fatal. Shared by this
-    module's event streams and obs/ledger.py's perf rows (``hint``
-    names what was being appended, for the warning)."""
+    a stderr warning naming the file and the byte offset of each torn
+    line (a silently half-read stream would fold a killed run into a
+    clean-looking artifact, and "somewhere in some stream" is useless
+    when a fleet dir holds one JSONL per host), never fatal. Shared by
+    this module's event streams and obs/ledger.py's perf rows (``hint``
+    names what was being appended, for the warning). Binary read: byte
+    offsets must be file offsets usable with ``tail -c``, not decoded
+    character counts."""
     records = []
-    skipped = 0
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except json.JSONDecodeError:
-                skipped += 1  # torn tail write of a killed process
-    if skipped:
-        print(f"warning: {path}: skipped {skipped} unparseable JSONL "
-              f"line(s) — torn tail of a killed {hint}?", file=sys.stderr)
+    torn_at: List[int] = []
+    offset = 0
+    with open(path, "rb") as fh:
+        for raw in fh:
+            line = raw.strip()
+            if line:
+                try:
+                    records.append(json.loads(line.decode("utf-8")))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    torn_at.append(offset)  # torn tail of a killed write
+            offset += len(raw)
+    if torn_at:
+        where = ", ".join(f"byte {o}" for o in torn_at[:4])
+        if len(torn_at) > 4:
+            where += f", … ({len(torn_at)} total)"
+        print(f"warning: {path}: skipped {len(torn_at)} unparseable "
+              f"JSONL line(s) at {where} — torn tail of a killed "
+              f"{hint}?", file=sys.stderr)
     return records
+
+
+def event_streams(path: str) -> Dict[int, str]:
+    """The per-host streams in a run dir: host index → file path.
+    Discovers the grafttower names (``events_p<k>.jsonl``) and the
+    pre-grafttower ones (``events.jsonl`` = host 0, ``events.<i>.jsonl``)
+    so old run dirs keep folding."""
+    streams: Dict[int, str] = {}
+    for name in sorted(os.listdir(path)):
+        idx = None
+        if name.startswith("events_p") and name.endswith(".jsonl"):
+            mid = name[len("events_p"):-len(".jsonl")]
+            idx = int(mid) if mid.isdigit() else None
+        elif name == "events.jsonl":
+            idx = 0
+        elif name.startswith("events.") and name.endswith(".jsonl"):
+            mid = name[len("events."):-len(".jsonl")]
+            idx = int(mid) if mid.isdigit() else None
+        if idx is not None and idx not in streams:
+            streams[idx] = os.path.join(path, name)
+    return streams
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
     """Parse one JSONL event file, or a run dir — folding EVERY per-host
-    stream it holds (``events.jsonl`` = host 0, ``events.<i>.jsonl`` =
-    the others; obs/events.py::event_log_path) into one list ordered by
-    wall time, so a multi-host run's quorum/heal/preempt records
-    interleave the way the fleet experienced them. Each record already
-    carries its ``process`` stamp. Tolerates a torn tail line per stream
-    (load_jsonl_tolerant)."""
+    stream it holds (``events_p<k>.jsonl``, plus the legacy
+    ``events.jsonl``/``events.<i>.jsonl`` names; see event_streams) into
+    one list ordered by wall time, so a multi-host run's quorum/heal/
+    preempt records interleave the way the fleet experienced them. Each
+    record already carries its ``process`` stamp. Tolerates a torn tail
+    line per stream (load_jsonl_tolerant). For the skew-corrected fleet
+    timeline use ``--fleet`` / obs/fleet.py — wall order is only as
+    honest as the hosts' clocks."""
     if not os.path.isdir(path):
         return load_jsonl_tolerant(path, hint="run")
-    streams = sorted(
-        name for name in os.listdir(path)
-        if name == "events.jsonl"
-        or (name.startswith("events.") and name.endswith(".jsonl")))
     records: List[Dict[str, Any]] = []
-    for name in streams:
-        records.extend(load_jsonl_tolerant(os.path.join(path, name),
-                                           hint="run"))
+    for _, stream_path in sorted(event_streams(path).items()):
+        records.extend(load_jsonl_tolerant(stream_path, hint="run"))
     records.sort(key=lambda e: e.get("t_wall", 0.0))
     return records
 
@@ -296,6 +323,16 @@ def bench_blob(summary: Dict[str, Any]) -> Dict[str, Any]:
         # a cross-run regression is attributable to env change too).
         "anomaly_count": len(summary["anomalies"]),
         "health_checks": summary["health"]["checks"],
+        # grafttower (--fleet folds only): the skew/wait aggregate, so
+        # multi-host ledger rows carry "how lockstep was the fleet"
+        # next to throughput (obs/fleet.py).
+        **({"fleet_skew_p50_s": summary["fleet"]["skew"]["p50_s"],
+            "fleet_skew_p90_s": summary["fleet"]["skew"]["p90_s"],
+            "fleet_barrier_wait_s":
+                summary["fleet"]["barriers"]["total_wait_s"],
+            "fleet_straggler": summary["fleet"]["straggler"],
+            "fleet_hung_hosts": summary["fleet"]["hung"]}
+           if "fleet" in summary else {}),
         **{k: summary["run"][k]
            for k in ("jax_version", "jaxlib_version", "git_dirty")
            if k in summary["run"]},
@@ -399,19 +436,50 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mx_rcnn_tpu.obs.report",
         description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="run directory (holding events.jsonl) "
-                                 "or a JSONL file")
+    ap.add_argument("path", help="run directory (holding per-host "
+                                 "events_p<k>.jsonl streams) or a JSONL "
+                                 "file")
+    ap.add_argument("--fleet", action="store_true",
+                    help="grafttower fold: merge every host stream onto "
+                         "one skew-corrected fleet timeline and append "
+                         "the straggler/barrier/heartbeat report "
+                         "(obs/fleet.py; path must be a run dir)")
     ap.add_argument("--json", dest="json_out", default=None,
                     metavar="OUT.json",
                     help="also write the BENCH-compatible JSON blob here")
     args = ap.parse_args(argv)
-    try:
-        events = load_events(args.path)
-    except OSError as exc:
-        print(f"error: cannot read {args.path}: {exc}", file=sys.stderr)
-        return 2
-    summary = summarize(events)
-    print(render(summary))
+    if args.fleet:
+        from mx_rcnn_tpu.obs import fleet
+
+        if not os.path.isdir(args.path):
+            print(f"error: --fleet needs a run directory of per-host "
+                  f"streams, got {args.path}", file=sys.stderr)
+            return 2
+        try:
+            hosts = {idx: load_jsonl_tolerant(p, hint="run")
+                     for idx, p in event_streams(args.path).items()}
+        except OSError as exc:
+            print(f"error: cannot read {args.path}: {exc}",
+                  file=sys.stderr)
+            return 2
+        if not hosts:
+            print(f"error: no event streams in {args.path}",
+                  file=sys.stderr)
+            return 2
+        events = fleet.merge_streams(hosts)
+        summary = summarize(events)
+        summary["fleet"] = fleet.fleet_summary(hosts)
+        print(render(summary))
+        print(fleet.render_fleet(summary["fleet"]))
+    else:
+        try:
+            events = load_events(args.path)
+        except OSError as exc:
+            print(f"error: cannot read {args.path}: {exc}",
+                  file=sys.stderr)
+            return 2
+        summary = summarize(events)
+        print(render(summary))
     if args.json_out:
         with open(args.json_out, "w", encoding="utf-8") as fh:
             json.dump(bench_blob(summary), fh, indent=2, sort_keys=True)

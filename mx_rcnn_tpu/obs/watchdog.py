@@ -57,22 +57,33 @@ class StallWatchdog:
 
     def __init__(self, log: EventLog, stall_factor: float = 10.0,
                  min_stall_s: float = 60.0, poll_s: float = 5.0,
-                 window: int = 101, tracer=None, recorder=None):
+                 window: int = 101, tracer=None, recorder=None,
+                 heartbeat_every_s: float = 0.0):
         """``tracer``: optional graftprof TraceController — when a stall
         fires, ONE jax.profiler window is auto-armed before the stack
         dump (``tracer.stall_window()``), so a hung run leaves a trace
         of the stall alongside the stacks (obs/profile.py).
         ``recorder``: optional graftpulse FlightRecorder — the stall dump
         also flushes the last-K-events ring (obs/health.py), so the
-        artifact says what the numbers were doing when the run hung."""
+        artifact says what the numbers were doing when the run hung.
+        ``heartbeat_every_s``: grafttower liveness beacon cadence (0 =
+        off) — this thread additionally emits a ``heartbeat`` event at
+        that cadence (flushed immediately, and into the flight ring via
+        the log's attach_ring), plus one final=True beat from stop(). A
+        SIGKILL skips stop(), so a stream whose heartbeat trail goes
+        stale with no final beat was KILLED; a slow host keeps beating
+        (obs/fleet.py folds the distinction)."""
         self.log = log
         self.tracer = tracer
         self.recorder = recorder
         self.stall_factor = float(stall_factor)
         self.min_stall_s = float(min_stall_s)
         self.poll_s = float(poll_s)
+        self.heartbeat_every_s = float(heartbeat_every_s)
         self._durations = deque(maxlen=window)
         self._last_beat = time.monotonic()
+        self._last_heartbeat: Optional[float] = None
+        self._final_sent = False
         self._fired = False
         self._paused = False
         self._stalls = 0
@@ -160,11 +171,52 @@ class StallWatchdog:
             self.recorder.dump("stall")
         return True
 
+    def maybe_heartbeat(self, now: Optional[float] = None) -> bool:
+        """Emit a ``heartbeat`` event when the cadence is due (at most
+        one per heartbeat_every_s; the first call always emits, so even
+        a seconds-long run leaves one beacon). Separated from the thread
+        loop so tests drive the cadence synchronously. Returns True when
+        a beat was emitted."""
+        if not self.heartbeat_every_s:
+            return False
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if (self._last_heartbeat is not None
+                    and now - self._last_heartbeat < self.heartbeat_every_s):
+                return False
+            self._last_heartbeat = now
+        self._emit_heartbeat(now=now)
+        return True
+
+    def _emit_heartbeat(self, final: bool = False,
+                        now: Optional[float] = None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            beat_age = now - self._last_beat
+            stalls = self._stalls
+        self.log.emit(
+            "heartbeat",
+            every_s=self.heartbeat_every_s,
+            beat_age_s=round(max(0.0, beat_age), 3),
+            stalls=stalls,
+            final=final)
+
     def _run(self):
-        while not self._stop.wait(self.poll_s):
+        # The heartbeat shares this thread (one daemon thread per run):
+        # wake at whichever cadence is shorter so neither starves.
+        wait_s = (min(self.poll_s, self.heartbeat_every_s)
+                  if self.heartbeat_every_s else self.poll_s)
+        self.maybe_heartbeat()
+        while not self._stop.wait(wait_s):
             self.check()
+            self.maybe_heartbeat()
 
     def stop(self):
         self._stop.set()
         if self._thread.is_alive():
             self._thread.join(timeout=self.poll_s + 1.0)
+        if self.heartbeat_every_s and not self._final_sent:
+            # The clean-shutdown marker: its absence at end-of-stream is
+            # how the fleet fold tells a killed host from a finished one.
+            self._final_sent = True
+            self._emit_heartbeat(final=True)

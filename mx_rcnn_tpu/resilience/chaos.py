@@ -17,6 +17,9 @@ travels in the ``MX_RCNN_CHAOS`` environment variable so subprocess tests
                                                    # returns with 4 devices
     MX_RCNN_CHAOS="nan_at_step=5"                  # poison step 5's grads
                                                    # in-graph (graftpulse)
+    MX_RCNN_CHAOS="slow_step_at=1:2:250"           # host 1 drags a 250 ms
+                                                   # tail from step 2 on
+                                                   # (grafttower straggler)
 
 Pairs are space- or comma-separated ``key=value``; unknown keys raise (a
 typo'd injection silently doing nothing would un-test the gate it was
@@ -129,6 +132,14 @@ class ChaosSpec:
     #: the "train_dispatch" site; every other host parses the same spec
     #: and no-ops.
     host_die_at_step: str = ""
+    #: Deterministic straggler: ``H:K:ms`` sleeps ``ms`` milliseconds at
+    #: the "train_dispatch" site on the host whose index is H
+    #: (simulated-host identity, parallel/distributed.py), at EVERY
+    #: optimizer step >= K — a persistent per-dispatch tail, so the
+    #: grafttower fleet fold sees one host consistently late and must
+    #: rank it straggler / attribute the barrier wait to it. Every other
+    #: host parses the same spec and no-ops.
+    slow_step_at: str = ""
     #: Make THIS host (optionally scoped ``H:site``) skip arriving at
     #: the named barrier site — the others see a partial arrival set at
     #: the deadline, which is the deterministic way to drive the
@@ -215,6 +226,17 @@ class ChaosSpec:
             _counters["host_die"] = 1
             os.kill(os.getpid(), signal.SIGKILL)
 
+    def maybe_slow_step(self, step: int):
+        """Sleep the armed ``slow_step_at=H:K:ms`` tail when this host's
+        index is H and the optimizer step about to dispatch is >= K —
+        host-side only (the sleep sits before the dispatch, it adds no
+        device sync)."""
+        if not self.slow_step_at:
+            return
+        host, at, ms = self.slow_step_at.split(":")
+        if _host_index() == int(host) and step >= int(at):
+            time.sleep(float(ms) / 1e3)
+
     def maybe_barrier_timeout(self, site_name: str) -> bool:
         """True when this host should SKIP arriving at ``site_name`` —
         the quorum barrier then sees a partial set at its deadline.
@@ -245,6 +267,7 @@ class ChaosSpec:
         self.maybe_die(name)
         if name == "train_dispatch":
             self.maybe_host_die(step)
+            self.maybe_slow_step(step)
             self.maybe_device_loss(step)
         elif name == "backend_reacquire":
             devices = self.maybe_shrink(devices)
@@ -296,6 +319,18 @@ def parse(text: str) -> ChaosSpec:
                 f"bad {ENV_VAR} host_die_at_step "
                 f"{kw['host_die_at_step']!r}; expected H:K (host index, "
                 "step)")
+    if kw.get("slow_step_at"):
+        parts = kw["slow_step_at"].split(":")
+        ok = len(parts) == 3 and parts[0].isdigit() and parts[1].isdigit()
+        if ok:
+            try:
+                float(parts[2])
+            except ValueError:
+                ok = False
+        if not ok:
+            raise ValueError(
+                f"bad {ENV_VAR} slow_step_at {kw['slow_step_at']!r}; "
+                "expected H:K:ms (host index, step, sleep milliseconds)")
     if kw.get("barrier_timeout_at"):
         _, sep, target = kw["barrier_timeout_at"].partition(":")
         site_name = target if sep else kw["barrier_timeout_at"]

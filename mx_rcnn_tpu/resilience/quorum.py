@@ -208,7 +208,15 @@ class Quorum:
                  timeout_s: float = 60.0, min_fraction: float = 0.5,
                  poll_s: float = 0.05,
                  clock: Callable[[], float] = time.monotonic,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 elog=None):
+        """``elog``: optional graftscope EventLog (duck-typed; None or a
+        NullEventLog both no-op) — every barrier then emits a typed
+        ``barrier`` event from this host's view: name, own wait_s
+        (monotonic), arrival order over the shared wall stamps, who
+        arrived last, timed_out. The grafttower fleet fold (obs/fleet.py)
+        attributes the waiters' time to the last arriver and uses the
+        releases as its residual-clock-skew correction signal."""
         self.store = store
         self.index = index
         self.count = count
@@ -217,6 +225,7 @@ class Quorum:
         self.poll_s = poll_s
         self._clock = clock
         self._sleep = sleep
+        self.elog = elog
         self.active: Set[int] = set(range(count))
 
     # -- identity ----------------------------------------------------------
@@ -244,18 +253,62 @@ class Quorum:
                 return None
             self._sleep(self.poll_s)
 
+    def _arrival_stamps(self, prefix: str, arrived: Set[int]
+                        ) -> Dict[int, float]:
+        """The wall stamps the arrived hosts published (barrier arrivals
+        carry ``time.time()`` so arrival ORDER is recoverable across
+        hosts — shared-store stamps, comparable to within NTP skew; a
+        pre-grafttower "1" value reads as stampless and is skipped:
+        it parses as float, so plausibility — a wall stamp is seconds
+        since the epoch — is the discriminator, not parseability)."""
+        stamps: Dict[int, float] = {}
+        for i in arrived:
+            raw = self.store.get(f"{prefix}/{i}")
+            if raw is None:
+                continue
+            try:
+                stamp = float(raw)
+            except ValueError:
+                continue
+            if stamp >= 1e9:  # Sep 2001 — anything earlier isn't a stamp
+                stamps[i] = stamp
+        return stamps
+
+    def _emit_barrier(self, name: str, wait_s: float, arrived: Set[int],
+                      stamps: Dict[int, float]):
+        """One ``barrier`` event from this host's view (obs/fleet.py
+        folds all views; the shared stamps make every view agree on
+        the arrival order)."""
+        if self.elog is None:
+            return
+        order = sorted(stamps, key=stamps.get)
+        self.elog.emit(
+            "barrier",
+            name=name,
+            wait_s=round(wait_s, 4),
+            arrived=sorted(arrived),
+            absent=sorted(self.active - arrived),
+            order=order,
+            last=order[-1] if order else None,
+            timed_out=not arrived >= self.active)
+
     def barrier(self, name: str, timeout_s: Optional[float] = None
                 ) -> Set[int]:
         """Arrive at ``name`` and wait for the active set; returns who
         arrived by the deadline (a superset check is the caller's job).
+        Arrival publishes this host's wall stamp (value = time.time(),
+        read back only for presence by the wait loop — order/attribution
+        live in the emitted ``barrier`` event).
 
         Chaos: ``barrier_timeout_at=<site>`` armed for this process makes
         it NOT arrive (simulating a host hung past the deadline) — the
         others then see a partial set, which is exactly the exclusion
         path under test.
         """
+        t0 = self._clock()
         if not chaos.site("quorum_barrier"):
-            self.store.set(f"{name}/arrive/{self.index}", "1")
+            self.store.set(f"{name}/arrive/{self.index}",
+                           repr(time.time()))
         deadline = self._clock() + (timeout_s if timeout_s is not None
                                     else self.timeout_s)
         arrived: Set[int] = set()
@@ -263,8 +316,11 @@ class Quorum:
             arrived = {i for i in self.active
                        if self.store.get(f"{name}/arrive/{i}") is not None}
             if arrived >= self.active or self._clock() >= deadline:
-                return arrived
+                break
             self._sleep(self.poll_s)
+        self._emit_barrier(name, self._clock() - t0, arrived,
+                           self._arrival_stamps(f"{name}/arrive", arrived))
+        return arrived
 
     def propose(self, name: str, value: str) -> str:
         return self.store.propose(f"{name}/value", value)
@@ -290,7 +346,12 @@ class Quorum:
         :class:`QuorumError` on every host.
         """
         ns = f"heal/{generation}"
+        t0 = self._clock()
         if not chaos.site("quorum_barrier"):
+            # Arrival stamp rides NEXT to the device publication: the
+            # dev value is protocol payload (parsed as an int below),
+            # so the barrier-event wall stamp gets its own key.
+            self.store.set(f"{ns}/stamp/{self.index}", repr(time.time()))
             self.store.set(f"{ns}/dev/{self.index}", str(n_devices))
         deadline = self._clock() + self.timeout_s
         while True:
@@ -304,6 +365,8 @@ class Quorum:
             if self._clock() >= deadline:
                 break
             self._sleep(self.poll_s)
+        self._emit_barrier(ns, self._clock() - t0, arrived,
+                           self._arrival_stamps(f"{ns}/stamp", arrived))
 
         sealed = self.store.get(f"{ns}/seal")
         if sealed is None and self.index == min(arrived | {self.index}):

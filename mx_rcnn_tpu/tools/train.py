@@ -403,7 +403,8 @@ def fit_detector(
                 obs_log, stall_factor=cfg.obs.stall_factor,
                 min_stall_s=cfg.obs.stall_min_s,
                 poll_s=cfg.obs.watchdog_poll_s, tracer=tracer,
-                recorder=recorder)
+                recorder=recorder,
+                heartbeat_every_s=cfg.obs.heartbeat_every_s)
             watchdog.start()
     timer = StepTimer(obs_log, watchdog=watchdog,
                       enrich=obs_costs.step_fields if obs_log.enabled
@@ -436,7 +437,12 @@ def fit_detector(
             quorum = Quorum(
                 store, process_index(), n_hosts,
                 timeout_s=cfg.resilience.quorum_timeout_s,
-                min_fraction=cfg.resilience.quorum_min_fraction)
+                min_fraction=cfg.resilience.quorum_min_fraction,
+                # grafttower: every barrier leaves a typed `barrier`
+                # event in this host's stream (wait attribution + the
+                # fleet fold's clock-skew correction signal). Host-side
+                # only — no device work rides a barrier.
+                elog=obs_log if obs_log.enabled else None)
             stopper = CoordinatedStop(quorum)
             logger.info(
                 "graftquorum: host %d/%d coordinating via %s",

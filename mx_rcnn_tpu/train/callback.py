@@ -24,13 +24,16 @@ class Speedometer:
         self.batch_size = batch_size
         self.frequent = frequent
         self.event_log = event_log
-        self._tic = time.time()
+        # monotonic, not wall: an NTP step inside a window would corrupt
+        # the samples/sec line (wall-time-duration lint rule).
+        self._tic = time.monotonic()
         self._count = 0
 
     def __call__(self, epoch: int, batch: int, metrics: MetricBag):
         self._count += 1
         if self._count % self.frequent == 0:
-            speed = self.frequent * self.batch_size / (time.time() - self._tic)
+            speed = (self.frequent * self.batch_size
+                     / (time.monotonic() - self._tic))
             logger.info(
                 "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s",
                 epoch, batch, speed, metrics.format(),
@@ -39,6 +42,6 @@ class Speedometer:
                 self.event_log.emit("step", epoch=epoch, batch=batch,
                                     samples_per_sec=round(speed, 3),
                                     window=self.frequent)
-            self._tic = time.time()
+            self._tic = time.monotonic()
             return speed
         return None

@@ -12,4 +12,24 @@
 # and in any future CI.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-JAX_PLATFORMS=cpu exec python -m pytest -m chaos "$@"
+JAX_PLATFORMS=cpu python -m pytest -m chaos "$@"
+
+# grafttower fleet-report smoke: a real 2-sim-host run (shared FileKVStore
+# quorum, fast heartbeats), then the --fleet fold over its per-host
+# events_p<k>.jsonl streams must exit 0 and print the straggler table the
+# OUTAGES "which host is the problem?" runbook starts from.
+FLEET_DIR="$(mktemp -d)"
+trap 'rm -rf "$FLEET_DIR"' EXIT
+for i in 0 1; do
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
+    python tests/_resilience_driver.py --fit "$FLEET_DIR/run" \
+      --sim-host "$i" --sim-hosts 2 \
+      --quorum-dir "$FLEET_DIR/kv" --quorum-timeout 15 \
+      --obs-dir "$FLEET_DIR/obs" \
+      --set obs.heartbeat_every_s=0.2 &
+done
+wait
+JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.obs.report --fleet "$FLEET_DIR/obs" \
+  | tee "$FLEET_DIR/report.txt"
+grep -q "straggler table" "$FLEET_DIR/report.txt"
+echo "fleet-report smoke: OK"

@@ -140,6 +140,22 @@ def _crash_save(prefix: str, scale: float = 1.0):
                     {"w": scale * np.arange(6, dtype=np.float32).reshape(2, 3)})
 
 
+def _coerce(raw: str):
+    """Literal coercion for --set values: int, float, bool, else str."""
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    low = raw.strip().lower()
+    if low in ("true", "false"):
+        return low == "true"
+    return raw
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--fit", metavar="PREFIX",
@@ -172,6 +188,13 @@ def main(argv=None):
     p.add_argument("--elastic-mode", default="",
                    choices=["", "shrink", "grow", "rescale"],
                    help="resilience.elastic_mode override")
+    # grafttower gates thread heartbeat/fleet knobs through here without
+    # growing a flag per knob: repeatable dotted config overrides with
+    # literal coercion (int -> float -> bool -> str).
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="extra dotted config override (repeatable), e.g. "
+                        "--set obs.heartbeat_every_s=0.2")
     args = p.parse_args(argv)
 
     if args.sim_host is not None or args.sim_hosts is not None:
@@ -208,6 +231,11 @@ def main(argv=None):
             over_extra["resilience.quorum_timeout_s"] = args.quorum_timeout
         if args.elastic_mode:
             over_extra["resilience.elastic_mode"] = args.elastic_mode
+        for pair in args.overrides:
+            key, sep, raw = pair.partition("=")
+            if not sep:
+                p.error(f"--set expects KEY=VALUE, got {pair!r}")
+            over_extra[key] = _coerce(raw)
         run_fit(args.fit, end_epoch=args.end_epoch, resume=args.resume,
                 flat=args.flat, obs_dir=args.obs_dir, mesh=args.mesh,
                 num_images=args.num_images, compute=args.compute,

@@ -1641,3 +1641,78 @@ def test_thread_race_near_miss_init_writes_and_threadless_class():
                 self._n += 2
     """)
     assert "thread-shared-mutation" not in rules_of(findings)
+
+# ---------------------------------------------------------------------------
+# wall-time-duration (grafttower): durations from wall-clock subtraction
+# ---------------------------------------------------------------------------
+
+
+def test_wall_time_duration_flags_time_time_subtraction():
+    findings = lint("""
+        import time
+
+        def timed_step(run):
+            t0 = time.time()
+            run()
+            return time.time() - t0
+    """)
+    assert "wall-time-duration" in rules_of(findings)
+    msg = next(f for f in findings
+               if f.rule == "wall-time-duration").message
+    assert "monotonic" in msg
+
+
+def test_wall_time_duration_flags_t_wall_field_and_self_attr():
+    """Both spellings of a persisted wall sample: the event-record
+    ``t_wall`` field (dict subscript / .get) and an attribute bound from
+    time.time() elsewhere in the class."""
+    findings = lint("""
+        import time
+
+        class Meter:
+            def start(self):
+                self._tic = time.time()
+
+            def lap(self):
+                return time.time() - self._tic
+
+        def stream_gap(ev, prev):
+            return ev["t_wall"] - prev.get("t_wall")
+    """)
+    assert sum(f.rule == "wall-time-duration" for f in findings) == 2
+
+
+def test_wall_time_duration_near_miss_monotonic_clocks():
+    """The fix the rule asks for must not itself flag: monotonic /
+    perf_counter durations, including ones bound through locals."""
+    findings = lint("""
+        import time
+
+        def timed_step(run):
+            t0 = time.monotonic()
+            run()
+            return time.monotonic() - t0
+
+        def profiled(run):
+            tic = time.perf_counter()
+            run()
+            return time.perf_counter() - tic
+    """)
+    assert "wall-time-duration" not in rules_of(findings)
+
+
+def test_wall_time_duration_near_miss_stamps_without_durations():
+    """Wall stamps are fine when they aren't differenced: correlation
+    stamps on records, and subtractions where the other operand's
+    provenance is unknown (a deadline passed in by the caller)."""
+    findings = lint("""
+        import time
+
+        def stamp(record):
+            record["t_wall"] = time.time()
+            return record
+
+        def remaining(deadline):
+            return time.time() - deadline if deadline else None
+    """)
+    assert "wall-time-duration" not in rules_of(findings)

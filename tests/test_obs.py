@@ -92,8 +92,10 @@ def test_event_log_buffers_steps_flushes_critical(tmp_path):
 
 
 def test_event_log_path_per_process(tmp_path):
-    assert event_log_path(str(tmp_path)).endswith("events.jsonl")
-    assert event_log_path(str(tmp_path), 3).endswith("events.3.jsonl")
+    # grafttower naming: every host (process 0 included) is a peer
+    # stream of the fleet merge.
+    assert event_log_path(str(tmp_path)).endswith("events_p0.jsonl")
+    assert event_log_path(str(tmp_path), 3).endswith("events_p3.jsonl")
 
 
 def test_run_meta_fields_digest_and_versions():

@@ -318,7 +318,7 @@ def test_report_folds_per_host_streams_and_quorum_summary(tmp_path):
     d = str(tmp_path / "obs")
     log0 = open_event_log(d, process_index=0)
     log1 = open_event_log(d, process_index=1)
-    assert os.path.basename(log1.path) == "events.1.jsonl"
+    assert os.path.basename(log1.path) == "events_p1.jsonl"
     log0.emit("quorum", kind="heal", generation=0, hosts=[0],
               excluded=[1], devices=4, spec="4x1")
     log1.emit("quorum", kind="excluded", error="missed heal generation 0")
@@ -476,7 +476,7 @@ def test_multihost_heal_excludes_straggler(tmp_path):
     assert procs[0].returncode == 0, outs[0][-2000:]
     assert procs[1].returncode == RESUMABLE_RC, outs[1][-2000:]
 
-    events = report.load_events(obs)  # folds events.jsonl + events.1.jsonl
+    events = report.load_events(obs)  # folds both events_p<k>.jsonl
     (heal0,) = [e for e in events
                 if e["type"] == "heal" and e["process"] == 0]
     assert heal0["quorum_hosts"] == [0]
