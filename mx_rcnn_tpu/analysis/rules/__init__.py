@@ -16,6 +16,7 @@ from mx_rcnn_tpu.analysis.rules import (
     host_sync,
     obs_schema,
     prng,
+    queue_timeout,
     retry,
     shapes,
     thread_race,
@@ -40,6 +41,7 @@ ALL_RULES = (
     dtype_cast,
     health_pull,
     thread_race,
+    queue_timeout,
     unbarriered_publish,
     wall_time_duration,
 )

@@ -1,0 +1,194 @@
+"""blocking-queue-no-timeout: an uncancellable wait inside a worker.
+
+The graftfeed shape: a prefetch worker (or its consumer) blocks in
+``queue.Queue.get()`` / ``.put()`` with no ``timeout=`` and no
+``block=False``. Nothing interrupts a blocked queue wait — not the
+iterator's stop event, not ``close()``, not a data-stall deadline — so
+one wedged producer turns into a daemon thread pinned forever and a
+``close()`` that hangs at ``join()``. The repo's prefetcher
+(data/loader.py) deliberately uses ``Condition.wait(timeout=0.1)`` poll
+loops instead, re-checking the stop/supervision state every wakeup;
+this rule keeps new queue plumbing honest about the same discipline.
+
+Per class that constructs a ``threading.Thread`` (same machinery as
+thread-shared-mutation), the rule:
+
+- finds queue-holding attrs: ``self.q = queue.Queue(...)`` (also bare
+  ``Queue``/``SimpleQueue``/``LifoQueue``/``PriorityQueue``, instance
+  or class level);
+- closes the thread side transitively (``target=`` methods, ``run()``
+  on Thread subclasses, plus same-class ``self.m()`` callees) — BOTH
+  sides of a queue handoff can wedge, but only calls reachable from a
+  class that actually spawns a thread are concurrent at all, so the
+  whole class is in scope once it constructs one;
+- flags ``self.q.get(...)`` / ``self.q.put(...)`` calls that pass
+  neither ``timeout=`` nor ``block=False`` (positional forms
+  ``get(False)`` / ``put(item, False)`` count as non-blocking too, as
+  do ``get_nowait()`` / ``put_nowait()``, which never block).
+
+Module-level worker functions (``threading.Thread(target=fn)``) get the
+same treatment over locals assigned from a queue constructor. Classes
+that never construct a thread are out of scope: a single-threaded queue
+is just a deque with ceremony, and blocking there deadlocks loudly on
+the first call — not the once-a-week hang this rule exists for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from mx_rcnn_tpu.analysis.engine import FileContext, Finding
+from mx_rcnn_tpu.analysis.rules.thread_race import (
+    _close_thread_side,
+    _is_thread_subclass,
+    _methods_of,
+    _thread_targets,
+)
+from mx_rcnn_tpu.analysis.tracing import FuncNode, dotted_name
+
+NAME = "blocking-queue-no-timeout"
+RATIONALE = ("a Queue.get()/.put() with no timeout= and no block=False "
+             "in thread-handoff code waits uninterruptibly — stop "
+             "events and close() can never reach it (the graftfeed "
+             "wedged-worker shape)")
+
+_QUEUE_FACTORIES = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue",
+}
+_THREAD_NAMES = {"threading.Thread", "Thread"}
+#: position of the ``block`` argument when passed positionally.
+_BLOCK_POS = {"get": 0, "put": 1}
+
+
+def _is_queue_ctor(value: ast.AST) -> bool:
+    return (isinstance(value, ast.Call)
+            and dotted_name(value.func) in _QUEUE_FACTORIES)
+
+
+def _blocks_forever(call: ast.Call, method: str) -> bool:
+    """True when this get/put call can wait without bound: no timeout=,
+    no block=False (keyword or positional)."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return False
+        if (kw.arg == "block"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False):
+            return False
+    pos = _BLOCK_POS[method]
+    if (len(call.args) > pos
+            and isinstance(call.args[pos], ast.Constant)
+            and call.args[pos].value is False):
+        return False
+    return True
+
+
+def _queue_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attrs assigned from a queue constructor anywhere in the class
+    (``self.q = queue.Queue()`` in any method, or a class-level
+    default)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign)
+                and _is_queue_ctor(node.value)):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out.add(t.attr)
+            elif isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _flag_calls(ctx: FileContext, body: ast.AST, is_queue,
+                owner: str) -> Iterator[Finding]:
+    """Findings for every forever-blocking get/put on a queue receiver
+    inside ``body``; ``is_queue(node) -> bool`` recognizes receivers."""
+    for node in ast.walk(body):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCK_POS):
+            continue
+        if not is_queue(node.func.value):
+            continue
+        method = node.func.attr
+        if not _blocks_forever(node, method):
+            continue
+        yield ctx.finding(
+            NAME, node,
+            f"`.{method}()` on a queue in {owner} passes neither "
+            "`timeout=` nor `block=False` — a wedged peer pins this "
+            "wait forever (stop events and close() can't interrupt a "
+            "blocked queue op); poll with a timeout and re-check the "
+            "stop state, like data/loader.py's prefetcher")
+
+
+def _check_class(ctx: FileContext,
+                 cls: ast.ClassDef) -> Iterator[Finding]:
+    methods = _methods_of(cls)
+    seeds = _thread_targets(cls, methods)
+    if _is_thread_subclass(cls) and "run" in methods:
+        seeds.add("run")
+    if not seeds:
+        return  # no thread born here — a blocked call deadlocks loudly
+    queues = _queue_attrs(cls)
+    if not queues:
+        return
+    thread_side = _close_thread_side(methods, seeds)
+
+    def _is_queue_recv(recv: ast.AST) -> bool:
+        return (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and recv.attr in queues)
+
+    for mname, m in methods.items():
+        where = ("the thread target" if mname in thread_side
+                 else "the consumer side")
+        yield from _flag_calls(ctx, m, _is_queue_recv,
+                               f"`{cls.name}.{mname}` ({where})")
+
+
+def _module_thread_fns(ctx: FileContext) -> Set[str]:
+    """Top-level function names passed as ``target=`` to a Thread
+    constructed anywhere in the module (outside any class)."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func) in _THREAD_NAMES):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                out.add(kw.value.id)
+    return out
+
+
+def _check_function(ctx: FileContext, fn: FuncNode) -> Iterator[Finding]:
+    locals_q: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_queue_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    locals_q.add(t.id)
+    if not locals_q:
+        return
+
+    def _is_queue_recv(recv: ast.AST) -> bool:
+        return isinstance(recv, ast.Name) and recv.id in locals_q
+
+    yield from _flag_calls(ctx, fn, _is_queue_recv,
+                           f"thread target `{fn.name}`")
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef):
+            yield from _check_class(ctx, node)
+    thread_fns = _module_thread_fns(ctx)
+    for node in ctx.tree.body:
+        if isinstance(node, FuncNode) and node.name in thread_fns:
+            yield from _check_function(ctx, node)

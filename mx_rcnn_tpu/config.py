@@ -522,6 +522,39 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class DataConfig:
+    """graftfeed input-plane fault tolerance (mx_rcnn_tpu/data/feedguard.py
+    — classified record IO retry, deterministic quarantine, prefetch worker
+    supervision, data-stall deadlines). Runbook: OUTAGES.md."""
+
+    # Per-record retry window for TRANSIENT IO failures (EIO/ETIMEDOUT/
+    # stale NFS handle/truncated read — the storage flake taxonomy of
+    # resilience/backend.py applied to the input plane). A record that
+    # stays broken past the deadline is reclassified as permanent and
+    # quarantined. 0 disables retry (first failure classifies directly).
+    record_deadline_s: float = 60.0
+    record_backoff_base_s: float = 0.05
+    record_backoff_max_s: float = 5.0
+    # PERMANENTLY corrupt records (bad JPEG, malformed roidb entry) are
+    # quarantined — `data` event + <obs dir>/quarantine.jsonl append — and
+    # replaced by a deterministic substitute record f(seed, epoch, index)
+    # so the epoch stream (and kill->resume parity) stays bit-exact. When
+    # more than this fraction of the dataset lands in quarantine the
+    # dataset itself is broken: abort loudly (flight-recorder dump)
+    # instead of training on a stream of substitutes.
+    quarantine_max_fraction: float = 0.01
+    # A crashed prefetch worker thread is resurrected in place
+    # (`data_worker` event); after this many deaths within one iterator
+    # the input plane is declared broken and the run fails hard.
+    worker_restart_max: int = 3
+    # A blocking next() on the prefetch queue that exceeds this deadline
+    # raises DataStallError (classified, flight-dumped, names data-wait
+    # as the culprit) instead of hanging forever on dead storage.
+    # 0 disables the deadline (wait forever — pre-graftfeed behavior).
+    wait_deadline_s: float = 600.0
+
+
+@dataclass(frozen=True)
 class Config:
     network: NetworkConfig = field(default_factory=NetworkConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
@@ -531,6 +564,7 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    data: DataConfig = field(default_factory=DataConfig)
     seed: int = 0
 
     def with_updates(self, **kw) -> "Config":

@@ -1,0 +1,376 @@
+"""graftfeed — input-plane fault tolerance for the batch loaders.
+
+The resilience stack classifies backend failures (graftguard), heals
+mid-run device loss (graftheal), coordinates fleets (graftquorum) and
+trips on bad numerics (graftpulse) — but until this module the data
+plane had none of it: `_PrefetchIterator` re-raised ANY worker exception
+in the consumer, so one corrupt JPEG, truncated mmap read or stale NFS
+handle killed a multi-hour run, and a hung storage read stalled forever.
+
+This module applies the r5 postmortem treatment (classify, retry under a
+deadline, leave an event trail — resilience/backend.py) to per-record
+loads:
+
+- **Transient IO** (EIO / ETIMEDOUT / stale handle / truncated read) is
+  retried with exponential backoff + jitter under
+  ``data.record_deadline_s``; a record still failing past the deadline
+  is reclassified as permanent.
+- **Permanent corruption** (bad JPEG, malformed roidb entry) is
+  **quarantined**: a typed ``data`` event with the record id + reason,
+  an append to ``<obs dir>/quarantine.jsonl``, and a substitute record
+  chosen as a pure function of ``(seed, epoch, record_index)`` — the
+  epoch stream stays deterministic, so the kill→resume bit-exact parity
+  gate holds with quarantine active (``--resume auto`` re-applies the
+  prior run's quarantine file before replaying the epoch prefix).
+- **A broken dataset** (quarantined fraction above
+  ``data.quarantine_max_fraction``) aborts loudly instead of silently
+  training on a stream of substitutes.
+
+The stall/worker-death halves of graftfeed live in data/loader.py
+(``_PrefetchIterator``): a blocking ``next()`` past
+``data.wait_deadline_s`` raises :class:`DataStallError`, and a crashed
+prefetch worker is resurrected at its queue position up to
+``data.worker_restart_max`` times (:class:`DataWorkerError` past it).
+All three error classes deliberately do NOT subclass ``RuntimeError``:
+graftheal's session loop heals transient RuntimeErrors in-process, and a
+broken input plane must reach the crash-telemetry path (``crash`` event
++ flight-recorder dump), not a heal retry — the ``NumericsAnomaly``
+precedent (obs/health.py).
+
+Fault injection: chaos keys ``data_corrupt_at=E:I``,
+``data_io_error_at=E:I:N``, ``data_hang_at=E:I``,
+``data_worker_die_at=K`` (resilience/chaos.py; sites
+``data_record_load`` / ``data_worker_loop``). Runbook: OUTAGES.md.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.resilience import chaos
+
+#: OSError errnos that mark a record read as transient (retry): flaky
+#: local disk (EIO), network filesystem timeouts (ETIMEDOUT), NFS
+#: failover (ESTALE), and the interrupted/again pair.
+TRANSIENT_IO_ERRNOS = frozenset({
+    errno.EIO, errno.ETIMEDOUT, errno.ESTALE, errno.EAGAIN, errno.EINTR,
+})
+
+#: Message substrings that mark a non-OSError record failure as
+#: transient — the storage-flake signatures that surface wrapped in
+#: ValueError/RuntimeError from decoders and mmap readers.
+TRANSIENT_IO_MARKERS = (
+    "Input/output error",
+    "Stale file handle",
+    "timed out",
+    "ETIMEDOUT",
+    "ESTALE",
+    "truncated read",
+    "Resource temporarily unavailable",
+)
+
+
+class DataStallError(Exception):
+    """A blocking next() on the prefetch queue outlasted
+    ``data.wait_deadline_s`` — dead storage / wedged workers. NOT a
+    RuntimeError: must escape graftheal to the crash-telemetry path."""
+
+
+class DataWorkerError(Exception):
+    """Prefetch workers died more than ``data.worker_restart_max`` times
+    within one iterator — the input plane itself is broken."""
+
+
+class QuarantineExceededError(Exception):
+    """Quarantined fraction crossed ``data.quarantine_max_fraction`` —
+    the dataset is broken; training on substitutes would be silent
+    garbage. The quarantine.jsonl on disk is the evidence."""
+
+
+def classify_record_error(exc: BaseException) -> str:
+    """'transient' (retry under the record deadline) or 'permanent'
+    (quarantine) for one record-load failure — errno first (the honest
+    signal), message markers second (wrapped decoder/mmap errors)."""
+    if isinstance(exc, OSError) and exc.errno in TRANSIENT_IO_ERRNOS:
+        return "transient"
+    msg = str(exc)
+    return ("transient" if any(m in msg for m in TRANSIENT_IO_MARKERS)
+            else "permanent")
+
+
+class FeedGuard:
+    """Per-run input-plane guard: classification + retry + quarantine.
+
+    One instance per fit/eval (tools/train.py builds it next to the
+    loader), shared across epochs and across heal-time loader rebuilds —
+    the quarantine set is run-scoped state, like the checkpoint prefix.
+    Thread-safe: prefetch workers call :meth:`load` concurrently.
+
+    ``quarantine_path`` ("" disables persistence) is
+    ``<obs dir>/quarantine.jsonl``; with ``resume=True`` an existing
+    file is re-applied at construction so a resumed run substitutes the
+    same records the interrupted run did — the bit-exact parity
+    contract. ``sleep``/``clock``/``rng`` are injectable for tests.
+    """
+
+    def __init__(self, dcfg, n_records: int, seed: int = 0, elog=None,
+                 quarantine_path: str = "", resume: bool = False,
+                 chaos_spec: Optional[chaos.ChaosSpec] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng: Optional[random.Random] = None):
+        self.dcfg = dcfg
+        self.n_records = max(1, int(n_records))
+        self._seed = int(seed)
+        self._elog = elog
+        self._path = quarantine_path or ""
+        self._spec = chaos_spec if chaos_spec is not None else chaos.from_env()
+        self._sleep = sleep
+        self._clock = clock
+        # Backoff jitter decorrelates workers hammering a recovering
+        # mount; pid-seeded like backend.py, order-independent.
+        self._rng = rng or random.Random(os.getpid())
+        self._epoch = 0
+        self._lock = threading.Lock()
+        self._quarantined: Dict[int, str] = {}
+        self.retry_count = 0
+        if resume and self._path and os.path.exists(self._path):
+            self._reapply()
+
+    # -- knobs the prefetcher reads ------------------------------------
+
+    @property
+    def wait_deadline_s(self) -> float:
+        return self.dcfg.wait_deadline_s
+
+    @property
+    def worker_restart_max(self) -> int:
+        return self.dcfg.worker_restart_max
+
+    @property
+    def chaos_spec(self) -> chaos.ChaosSpec:
+        return self._spec
+
+    def set_epoch(self, epoch: int):
+        """Forwarded by AnchorLoader.set_epoch — the epoch feeds both
+        the chaos E:I keys and the deterministic replacement draw."""
+        self._epoch = int(epoch)
+
+    @property
+    def quarantined_count(self) -> int:
+        with self._lock:
+            return len(self._quarantined)
+
+    # -- event plumbing (thread-safe: EventLog locks internally) -------
+
+    def _emit(self, **fields):
+        if self._elog is not None and self._elog.enabled:
+            self._elog.emit("data", **fields)
+
+    def emit_worker_event(self, **fields):
+        """``data_worker`` emission hook for the prefetcher's worker
+        supervision (data/loader.py) — kept here so the loader needs no
+        EventLog plumbing of its own."""
+        if self._elog is not None and self._elog.enabled:
+            self._elog.emit("data_worker", **fields)
+
+    # -- quarantine ----------------------------------------------------
+
+    def _reapply(self):
+        """Re-arm a prior run's quarantine file (--resume auto): the
+        replayed epoch prefix must substitute the same records the
+        interrupted run did, without re-discovering them. Torn trailing
+        lines (SIGKILL mid-append) are a warning, not a crash."""
+        from mx_rcnn_tpu.obs.report import load_jsonl_tolerant
+
+        applied = 0
+        for rec in load_jsonl_tolerant(self._path, hint="quarantine file"):
+            try:
+                idx = int(rec["record"])
+            except (KeyError, TypeError, ValueError):
+                continue  # foreign line — the tolerant read already warned
+            if idx not in self._quarantined:
+                self._quarantined[idx] = str(rec.get("reason", ""))
+                applied += 1
+        if applied:
+            logger.info(
+                "graftfeed: re-applied %d quarantined record(s) from %s",
+                applied, self._path)
+            self._emit(kind="quarantine_applied", count=applied,
+                       path=self._path)
+
+    def _replacement(self, index: int) -> int:
+        """The substitute for a quarantined record: a pure function of
+        (seed, epoch, record_index) — kill→resume replays the same draw
+        — avoiding the current quarantine set (identical at equivalent
+        stream positions in both runs, because resume re-applies the
+        jsonl before replaying)."""
+        rng = np.random.RandomState(
+            ((self._seed * 1_000_003 + self._epoch) * 1_000_033
+             + index) % (2 ** 32))
+        with self._lock:
+            bad = set(self._quarantined)
+        bad.add(index)
+        for _ in range(64):
+            j = int(rng.randint(self.n_records))
+            if j not in bad:
+                return j
+        for j in range(self.n_records):  # nearly everything quarantined
+            if j not in bad:
+                return j
+        raise QuarantineExceededError(
+            f"every record is quarantined ({len(bad)}/{self.n_records}) — "
+            "no replacement exists; the dataset is broken "
+            f"(evidence: {self._path or 'quarantine persistence disabled'})")
+
+    def _quarantine(self, index: int, exc: BaseException) -> int:
+        """Quarantine ``index``: record it, persist it, emit the event,
+        enforce the cap, and return the deterministic replacement."""
+        reason = f"{type(exc).__name__}: {str(exc)[:300]}"
+        with self._lock:
+            fresh = index not in self._quarantined
+            if fresh:
+                self._quarantined[index] = reason
+            count = len(self._quarantined)
+        replacement = self._replacement(index)
+        if fresh:
+            logger.warning(
+                "graftfeed: quarantined record %d (epoch %d): %s — "
+                "substituting record %d", index, self._epoch, reason,
+                replacement)
+            self._persist(index, reason, replacement)
+            self._emit(kind="quarantine", record=index, epoch=self._epoch,
+                       reason=reason, replacement=replacement,
+                       quarantined=count, total=self.n_records)
+        cap = self.dcfg.quarantine_max_fraction
+        if count / self.n_records > cap:
+            # Evidence is already on disk (persist above) — abort loudly;
+            # the crash path dumps the flight recorder.
+            self._emit(kind="quarantine_cap", quarantined=count,
+                       total=self.n_records, cap=cap,
+                       path=self._path)
+            raise QuarantineExceededError(
+                f"{count}/{self.n_records} records quarantined "
+                f"({count / self.n_records:.1%} > "
+                f"data.quarantine_max_fraction={cap}) — the dataset is "
+                f"broken, refusing to train on substitutes "
+                f"(see {self._path or 'the data events'})")
+        return replacement
+
+    def _persist(self, index: int, reason: str, replacement: int):
+        if not self._path:
+            return
+        line = json.dumps({
+            "record": index, "epoch": self._epoch, "reason": reason,
+            "replacement": replacement, "t_wall": time.time(),
+        })
+        with self._lock:
+            try:
+                with open(self._path, "a", encoding="utf-8") as fh:
+                    fh.write(line + "\n")
+                    fh.flush()
+            except OSError as io_exc:
+                # The quarantine still holds in memory; losing the file
+                # only costs resume re-discovery.
+                logger.warning(
+                    "graftfeed: could not append to %s: %s",
+                    self._path, io_exc)
+
+    # -- the guarded load ----------------------------------------------
+
+    def resolve(self, index: int) -> int:
+        """Pre-load substitution: a record already known quarantined (a
+        prior epoch, or a resumed run's re-applied file) is replaced
+        WITHOUT re-attempting its load — a rotten record costs one
+        discovery, not one IO error per epoch."""
+        index = int(index)  # loaders hand over numpy ints; keep the
+        # quarantine set (and the persisted jsonl) in plain-int space
+        with self._lock:
+            known = index in self._quarantined
+        return self._replacement(index) if known else index
+
+    def load(self, load_fn: Callable[[int], object], index: int,
+             cancel: Optional[Callable[[], bool]] = None) -> Tuple[object, int]:
+        """Load record ``index`` via ``load_fn(i)``, riding transient IO
+        flakes and quarantining permanent corruption (substituting
+        deterministically, chaining if the substitute is rotten too).
+        Returns ``(result, actual_index)``. ``cancel`` is the
+        prefetcher's stop predicate, threaded into the hang injection so
+        an abandoned worker releases. Raises QuarantineExceededError
+        past the cap; with ``data.record_deadline_s == 0`` a transient
+        failure propagates raw (retry disabled — pre-graftfeed
+        behavior)."""
+        i = self.resolve(index)
+        while True:
+            try:
+                return self._attempt(load_fn, i, cancel), i
+            except QuarantineExceededError:
+                raise
+            except BaseException as exc:  # noqa: BLE001  # graftlint: disable=broad-except — classified: only give-up errors escape _attempt, and each is quarantined here, not swallowed
+                if (self.dcfg.record_deadline_s <= 0
+                        and classify_record_error(exc) == "transient"):
+                    raise  # retry disabled: the raw IO error stays loud
+                i = self._quarantine(i, exc)
+
+    def _attempt(self, load_fn: Callable[[int], object], i: int,
+                 cancel: Optional[Callable[[], bool]]):
+        """One record's retry loop — the backend.py shape: transient
+        failures back off exponentially (jittered) under
+        ``data.record_deadline_s``; permanent ones raise immediately;
+        a transient that outlasts the deadline raises too (the caller
+        reclassifies it as permanent and quarantines)."""
+        d = self.dcfg
+        spec = self._spec
+        start = self._clock()
+        deadline = start + max(0.0, d.record_deadline_s)
+        delay = max(0.001, d.record_backoff_base_s)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                if spec.active:
+                    spec.maybe_die("data_record_load")
+                    spec.maybe_data_corrupt(self._epoch, i)
+                    spec.maybe_data_io_error(self._epoch, i)
+                    spec.maybe_data_hang(self._epoch, i, cancel)
+                result = load_fn(i)
+            except BaseException as exc:  # noqa: BLE001  # graftlint: disable=broad-except — classified transient-vs-permanent and re-raised, not swallowed
+                if classify_record_error(exc) == "permanent":
+                    raise
+                waited = self._clock() - start
+                remaining = deadline - self._clock()
+                if d.record_deadline_s <= 0:
+                    raise  # retry disabled: propagate the raw IO error
+                if remaining <= 0:
+                    raise OSError(
+                        errno.EIO,
+                        f"record {i} still transiently failing after "
+                        f"{attempt} attempts / {waited:.1f}s (deadline "
+                        f"data.record_deadline_s="
+                        f"{d.record_deadline_s:.0f}s); last error: {exc}"
+                    ) from exc
+                pause = min(delay, d.record_backoff_max_s)
+                pause *= 1.0 + 0.25 * self._rng.random()
+                pause = min(pause, remaining)
+                with self._lock:
+                    self.retry_count += 1
+                self._emit(kind="retry", record=i, epoch=self._epoch,
+                           attempt=attempt, sleep_s=round(pause, 3),
+                           error=str(exc)[:200])
+                logger.warning(
+                    "graftfeed: transient IO on record %d (attempt %d, "
+                    "waited %.1fs): %s — retrying in %.2fs", i, attempt,
+                    waited, exc, pause)
+                self._sleep(pause)
+                delay = min(delay * 2.0, d.record_backoff_max_s)
+            else:
+                return result

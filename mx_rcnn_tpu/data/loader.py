@@ -21,12 +21,14 @@ TPU deltas:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.data.feedguard import DataStallError, DataWorkerError
 from mx_rcnn_tpu.data.image import (
     flip_image_and_boxes,
     load_image,
@@ -221,12 +223,21 @@ class _PrefetchIterator:
     the pool, drains the buffered results, and JOINS every worker, so a
     disposed iterator leaves no thread alive (the epoch-end contract
     tools/train.py relies on; tested in tests/test_datasets.py).
+
+    graftfeed (``guard`` — a data/feedguard.py FeedGuard): the consumer
+    supervises the pool while it waits — a worker thread that died
+    without a clean exit has its claimed queue position requeued and a
+    replacement spawned (``data_worker`` event; DataWorkerError past
+    ``data.worker_restart_max`` deaths) — and a blocking wait that
+    outlasts ``data.wait_deadline_s`` raises DataStallError instead of
+    hanging on dead storage. Without a guard both behaviors are off
+    (wait forever, die with the worker) — the pre-graftfeed contract.
     """
 
     _ids = iter(range(1_000_000_000))
 
     def __init__(self, make_batch, batch_indices: Sequence, depth: int = 4,
-                 workers: int = 4):
+                 workers: int = 4, guard=None):
         self._make = make_batch
         self._indices = list(batch_indices)
         self._slots = threading.Semaphore(max(1, depth))
@@ -236,38 +247,131 @@ class _PrefetchIterator:
         self._emitted = {}
         self._emit_cond = threading.Condition()
         self._stop = threading.Event()
+        self._guard = guard
+        self._claims: Dict[str, int] = {}   # thread name -> claimed pos
+        self._requeue: List[int] = []       # positions lost to dead workers
+        self._done: set = set()             # names that exited CLEANLY
+        self._deaths = 0
+        self._worker_fail: Optional[BaseException] = None
+        self._closed = False
         pool = next(self._ids)
         for i in range(max(1, workers)):
-            t = threading.Thread(target=self._worker, daemon=True,
+            t = threading.Thread(target=self._worker, args=(i,), daemon=True,
                                  name=f"loader-worker-{pool}-{i}")
             t.start()
             self._threads.append(t)
 
-    def _worker(self):
+    def _worker(self, widx: int):
+        name = threading.current_thread().name
+        spec = self._guard.chaos_spec if self._guard is not None else None
         while not self._stop.is_set():
             if not self._slots.acquire(timeout=0.1):
                 continue  # re-check stop flag
             with self._lock:
-                if self._next >= len(self._indices):
+                if self._requeue:  # a dead sibling's lost claim first
+                    pos = self._requeue.pop(0)
+                elif self._next < len(self._indices):
+                    pos = self._next
+                    self._next += 1
+                else:
                     self._slots.release()
+                    self._done.add(name)
                     return
-                pos = self._next
-                self._next += 1
+                self._claims[name] = pos
+            if spec is not None and spec.active:
+                spec.maybe_die("data_worker_loop")
+                if spec.maybe_worker_die(widx):
+                    # Abrupt chaos death: claim kept, slot kept, no
+                    # result — what a segfaulting decoder leaves behind;
+                    # consumer-side supervision must requeue + resurrect.
+                    return
             try:
                 result = ("ok", self._make(self._indices[pos]))
             except BaseException as exc:  # noqa: BLE001  # graftlint: disable=broad-except — captured and re-raised in the consumer, not swallowed
                 result = ("err", exc)
+            with self._lock:
+                self._claims.pop(name, None)
             with self._emit_cond:
                 # Preserve order: the consumer pops positions sequentially.
                 self._emitted[pos] = result
                 self._emit_cond.notify_all()
+        with self._lock:
+            self._done.add(name)
+
+    def _supervise(self):
+        """Consumer-side worker supervision (runs between waits): a
+        thread that died without a clean exit gets its claimed position
+        requeued and — restart budget permitting — a replacement thread
+        spawned; past ``data.worker_restart_max`` deaths the pool is
+        declared broken (the consumer raises DataWorkerError)."""
+        dead = [t for t in self._threads if not t.is_alive()]
+        for t in dead:
+            self._threads.remove(t)
+            with self._lock:
+                clean = t.name in self._done
+                pos = self._claims.pop(t.name, None)
+                if pos is not None:
+                    self._requeue.append(pos)
+            if clean:
+                continue
+            self._deaths += 1
+            guard = self._guard
+            limit = guard.worker_restart_max if guard is not None else 0
+            resurrect = guard is not None and self._deaths <= limit
+            logger.warning(
+                "loader worker %s died (death %d/%d)%s%s", t.name,
+                self._deaths, limit,
+                f", requeued position {pos}" if pos is not None else "",
+                " — resurrecting" if resurrect
+                else " — restart budget spent")
+            if guard is not None:
+                guard.emit_worker_event(
+                    worker=t.name, deaths=self._deaths, restart_max=limit,
+                    requeued=pos if pos is not None else -1,
+                    resurrected=resurrect)
+            if not resurrect:
+                self._worker_fail = DataWorkerError(
+                    f"{self._deaths} prefetch worker death(s) exceed "
+                    f"data.worker_restart_max={limit} — the input plane "
+                    "itself is broken (decoder/native crash loop); last "
+                    f"casualty: {t.name}")
+                return
+            if pos is not None:
+                # The dead worker still held its backpressure slot —
+                # hand it back or the pool deadlocks at depth exhaustion.
+                self._slots.release()
+            r = threading.Thread(target=self._worker, args=(-1,),
+                                 daemon=True,
+                                 name=f"{t.name}-r{self._deaths}")
+            r.start()
+            self._threads.append(r)
 
     def __iter__(self):
+        deadline_s = (self._guard.wait_deadline_s
+                      if self._guard is not None else 0.0)
         for pos in range(len(self._indices)):
-            with self._emit_cond:
-                while pos not in self._emitted and not self._stop.is_set():
+            t0 = time.monotonic()
+            while True:
+                with self._emit_cond:
+                    if pos in self._emitted:
+                        result = self._emitted.pop(pos)
+                        break
+                    if self._stop.is_set():
+                        result = None
+                        break
                     self._emit_cond.wait(timeout=0.1)
-                result = self._emitted.pop(pos, None)
+                self._supervise()
+                if self._worker_fail is not None:
+                    self._stop.set()
+                    raise self._worker_fail
+                if deadline_s and time.monotonic() - t0 > deadline_s:
+                    self._stop.set()
+                    raise DataStallError(
+                        f"no batch arrived at queue position {pos} within "
+                        f"data.wait_deadline_s={deadline_s:.0f}s "
+                        f"({len(self._threads)} worker(s) alive, "
+                        f"{self._deaths} death(s)) — storage is stuck or "
+                        "the input plane is wedged")
             if result is None:
                 return
             kind, payload = result
@@ -278,10 +382,15 @@ class _PrefetchIterator:
             yield payload
 
     def close(self):
-        """Stop, drain, and JOIN the pool. Idempotent. Workers poll the
+        """Stop, drain, and JOIN the pool. Idempotent (a second close —
+        or closing after a worker already crashed — is a no-op/skip, not
+        a block on a thread that will never drain). Workers poll the
         stop flag every 0.1 s while waiting for a slot and exit after at
         most one in-flight batch build, so the join is bounded by one
-        batch's assembly time."""
+        batch's assembly time; chaos-hung loads poll the same flag."""
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         for t in self._threads:
             if t.is_alive():
@@ -341,6 +450,28 @@ class _CloseableLoader:
                 1.0 - self._pad_real_px / self._pad_canvas_px, 4),
         }
 
+    #: graftfeed guard (data/feedguard.py FeedGuard) — None keeps every
+    #: pre-graftfeed behavior (no retry, no quarantine, wait forever).
+    _guard = None
+
+    def _feed_cancel(self) -> bool:
+        """Stop predicate threaded into the guard's cancel-aware hooks
+        (chaos hang injection): True once any of this loader's live
+        prefetchers has been stopped — a hung worker must release when
+        the consumer gives up (DataStallError) or the loader closes."""
+        return any(p._stop.is_set() for p in self._active)
+
+    def _guarded(self, load_one, i: int):
+        """Route one record load through graftfeed when armed: classified
+        transient-IO retry under data.record_deadline_s, quarantine +
+        deterministic substitution for permanent corruption. Returns
+        ``(result, actual_index)`` — the index differs from ``i`` when a
+        quarantine substituted, and per-entry side lookups (gt masks)
+        must follow it."""
+        if self._guard is None:
+            return load_one(i), i
+        return self._guard.load(load_one, i, cancel=self._feed_cancel)
+
     def _run_prefetch(self, it: _PrefetchIterator):
         self._active = self._active + (it,)
         try:
@@ -381,12 +512,18 @@ class AnchorLoader(_CloseableLoader):
     def __init__(self, roidb: List[Dict], cfg: Config, num_shards: int = 1,
                  shuffle: Optional[bool] = None, seed: int = 0,
                  prefetch_depth: int = 4, workers: int = 2,
-                 process_count: int = 1, process_index: int = 0):
+                 process_count: int = 1, process_index: int = 0,
+                 guard=None):
         """num_shards = data-axis shards THIS process feeds. Multi-host
         (process_count > 1): every process must use the SAME seed — the
         epoch order is computed over the global batch and each process
         loads its own column slice, preserving exact global-batch DP
-        semantics (parallel/distributed.py)."""
+        semantics (parallel/distributed.py).
+
+        ``guard`` is a graftfeed FeedGuard (data/feedguard.py) — built
+        once per run by fit_detector and shared across heal-time loader
+        rebuilds, because the quarantine set is run-scoped state. None
+        (standalone/dev iteration) keeps the pre-graftfeed behavior."""
         self.roidb = roidb
         self.cfg = cfg
         self.batch_size = cfg.train.batch_images * num_shards
@@ -399,6 +536,7 @@ class AnchorLoader(_CloseableLoader):
         self._rng = np.random.RandomState(seed)
         self._depth = prefetch_depth
         self._workers = workers
+        self._guard = guard
         self._canvas_spec = None
         if cfg.image.canvas_pack:
             from mx_rcnn_tpu.data.canvas import validate_canvas_pack
@@ -420,6 +558,10 @@ class AnchorLoader(_CloseableLoader):
         iteration without set_epoch keeps the legacy advancing stream."""
         self._rng = np.random.RandomState(
             (self._seed * 1_000_003 + epoch) % (2 ** 32))
+        if self._guard is not None:
+            # graftfeed: the epoch feeds the chaos E:I keys and the
+            # deterministic quarantine-replacement draw.
+            self._guard.set_epoch(epoch)
 
     def _epoch_order(self) -> np.ndarray:
         n = len(self.roidb)
@@ -501,6 +643,13 @@ class AnchorLoader(_CloseableLoader):
         from mx_rcnn_tpu.data.canvas import plan_batch
 
         cfg = self.cfg
+        if self._guard is not None:
+            # graftfeed pre-resolution: records already known quarantined
+            # are substituted BEFORE the planner measures content sizes,
+            # so planned rects match loaded pixels. A mid-batch DISCOVERY
+            # still substitutes at load time (the slot clamp below
+            # absorbs the size delta — one batch, once per record).
+            idxs = [self._guard.resolve(i) for i in idxs]
         spec = self._canvas_spec
         g = cfg.train.max_gt_boxes
         with_masks = cfg.network.use_mask
@@ -518,9 +667,12 @@ class AnchorLoader(_CloseableLoader):
                if with_masks else None)
         real_px = 0.0
         for j, i in enumerate(idxs):
-            entry = self.roidb[i]
-            img, iminfo, boxes, classes = _load_roidb_content(
-                entry, cfg, scale_idx, fit)
+            def _load_content(k, _s=scale_idx, _f=fit):
+                return _load_roidb_content(self.roidb[k], cfg, _s, _f)
+
+            (img, iminfo, boxes, classes), ri = self._guarded(
+                _load_content, i)
+            entry = self.roidb[ri]
             pl, y0, x0 = placements[j]
             slot = j % spec.images
             # Clamp into the canvas: a fit<1 double-resample can round a
@@ -560,14 +712,38 @@ class AnchorLoader(_CloseableLoader):
         g = cfg.train.max_gt_boxes
         with_masks = cfg.network.use_mask
         m = cfg.train.mask_gt_resolution
+        if self._guard is not None:
+            # graftfeed pre-resolution: known-quarantined records swap out
+            # BEFORE the orientation vote below, so the pad bucket matches
+            # what actually loads (a mid-batch discovery is clamped).
+            idxs = [self._guard.resolve(i) for i in idxs]
         pad = resolve_pad_bucket(cfg, scale_idx, [
             self.roidb[i].get("width", 1) >= self.roidb[i].get("height", 1)
             for i in idxs])
         imgs, infos, gtb, gtc, gtv, gtm = [], [], [], [], [], []
         for i in idxs:
-            entry = self.roidb[i]
-            img, info, boxes, classes = _load_roidb_entry(entry, cfg,
-                                                          scale_idx, pad)
+            def _load_entry(k, _s=scale_idx, _p=pad):
+                # A quarantine substitute can carry the other orientation;
+                # pad_image refuses overflow, so load those against the
+                # square cover and let the clamp below cut the batch shape.
+                e = self.roidb[k]
+                land = e.get("width", 1) >= e.get("height", 1)
+                fits = _p[1] >= _p[0] if land else _p[0] >= _p[1]
+                p = _p if fits else (max(_p), max(_p))
+                return _load_roidb_entry(e, cfg, _s, p)
+
+            (img, info, boxes, classes), ri = self._guarded(_load_entry, i)
+            entry = self.roidb[ri]
+            if img.shape[:2] != tuple(pad):
+                # A mid-batch quarantine substitute with the other
+                # orientation overflowed this batch's bucket — clamp its
+                # content in (deterministic; once per discovered record).
+                clamped = np.zeros((pad[0], pad[1], img.shape[2]),
+                                   img.dtype)
+                ch = min(img.shape[0], pad[0])
+                cw = min(img.shape[1], pad[1])
+                clamped[:ch, :cw] = img[:ch, :cw]
+                img = clamped
             b, c, v = _pad_gt(boxes, classes, g)
             imgs.append(img)
             infos.append(info)
@@ -609,7 +785,8 @@ class AnchorLoader(_CloseableLoader):
         items = [(batches[i], int(scale_ids[i])) for i in range(nb)]
         yield from self._run_prefetch(
             _PrefetchIterator(self._make_batch, items,
-                              depth=self._depth, workers=self._workers))
+                              depth=self._depth, workers=self._workers,
+                              guard=self._guard))
 
 
 class ROIIter(AnchorLoader):
@@ -666,12 +843,13 @@ class TestLoader(_CloseableLoader):
     __test__ = False  # pytest: not a test class, despite the name
 
     def __init__(self, roidb: List[Dict], cfg: Config, batch_size: int = 1,
-                 prefetch_depth: int = 4, workers: int = 2):
+                 prefetch_depth: int = 4, workers: int = 2, guard=None):
         self.roidb = roidb
         self.cfg = cfg
         self.batch_size = batch_size
         self._depth = prefetch_depth
         self._workers = workers
+        self._guard = guard  # graftfeed (epoch stays 0 for inference)
 
     def __len__(self):
         return (len(self.roidb) + self.batch_size - 1) // self.batch_size
@@ -692,11 +870,13 @@ class TestLoader(_CloseableLoader):
                 real = False
             else:
                 real = True
-            entry = self.roidb[i]
-            img, info, _, _ = _load_roidb_entry(
-                {**entry, "boxes": np.zeros((0, 4), np.float32),
-                 "gt_classes": np.zeros((0,), np.int32)}, cfg, scale_idx,
-                pad)
+
+            def _load_entry(k, _s=scale_idx, _p=pad):
+                return _load_roidb_entry(
+                    {**self.roidb[k], "boxes": np.zeros((0, 4), np.float32),
+                     "gt_classes": np.zeros((0,), np.int32)}, cfg, _s, _p)
+
+            (img, info, _, _), _ri = self._guarded(_load_entry, i)
             imgs.append(img)
             infos.append(info)
             metas.append({"index": i, "scale": float(info[2]), "real": real})
@@ -720,4 +900,5 @@ class TestLoader(_CloseableLoader):
         batches = idxs.reshape(-1, self.batch_size)
         yield from self._run_prefetch(
             _PrefetchIterator(self._make_batch, batches,
-                              depth=self._depth, workers=self._workers))
+                              depth=self._depth, workers=self._workers,
+                              guard=self._guard))

@@ -86,6 +86,19 @@ EVENT_TYPES = (
                    # arrived last, timed_out (resilience/quorum.py; the
                    # fleet fold attributes everyone's wait to the last
                    # arriver)
+    "data",        # graftfeed: one input-plane incident — kind
+                   # quarantine (record id + reason + deterministic
+                   # replacement), retry (transient IO flake ridden out
+                   # under data.record_deadline_s), quarantine_applied
+                   # (a resume re-armed a prior run's quarantine.jsonl),
+                   # quarantine_cap (fraction cap tripped — the abort),
+                   # stall (next() blew data.wait_deadline_s —
+                   # DataStallError) (data/feedguard.py, data/loader.py)
+    "data_worker", # graftfeed: one prefetch-worker death — worker name,
+                   # the queue position its claim was requeued at,
+                   # deaths so far vs data.worker_restart_max, and
+                   # whether a replacement thread was spawned
+                   # (data/loader.py worker supervision)
 )
 
 #: Buffered kinds — everything else flushes to disk immediately, so the

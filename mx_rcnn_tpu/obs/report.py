@@ -293,6 +293,32 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                       ("kind", "hosts", "excluded", "agreed", "spec")}
                      if by_type.get("quorum") else None),
         },
+        # graftfeed: input-plane fault accounting — which records were
+        # quarantined (and what replaced them), how often transient IO
+        # was retried, and whether any prefetch workers died mid-run.
+        # OUTAGES.md's "the data plane broke" runbook reads this fold.
+        "data": {
+            "quarantined": [
+                {"record": e.get("record"), "epoch": e.get("epoch"),
+                 "replacement": e.get("replacement"),
+                 "reason": e.get("reason")}
+                for e in by_type.get("data", ())
+                if e.get("kind") == "quarantine"],
+            "retries": sum(1 for e in by_type.get("data", ())
+                           if e.get("kind") == "retry"),
+            "retry_wait_s": round(sum(
+                e.get("sleep_s", 0.0) for e in by_type.get("data", ())
+                if e.get("kind") == "retry"), 3),
+            "reapplied": sum(e.get("count", 0)
+                             for e in by_type.get("data", ())
+                             if e.get("kind") == "quarantine_applied"),
+            "cap_trips": sum(1 for e in by_type.get("data", ())
+                             if e.get("kind") == "quarantine_cap"),
+            "worker_deaths": len(by_type.get("data_worker", ())),
+            "worker_resurrections": sum(
+                1 for e in by_type.get("data_worker", ())
+                if e.get("resurrected")),
+        },
         "crash": ({"error": crash.get("error"), "step": crash.get("step")}
                   if crash else None),
     }
@@ -313,6 +339,12 @@ def bench_blob(summary: Dict[str, Any]) -> Dict[str, Any]:
         "stall_count": summary["stalls"],
         "backend_retries": summary["backend"]["retries"],
         "heal_count": summary["heals"]["count"],
+        # graftfeed: quarantine pressure and worker churn belong on the
+        # same ledger row — a throughput regression with nonzero
+        # data_retries is a storage problem, not a model problem.
+        "data_quarantined": len(summary["data"]["quarantined"]),
+        "data_retries": summary["data"]["retries"],
+        "data_worker_deaths": summary["data"]["worker_deaths"],
         # graftprof: the computed-MFU / HBM / padding numbers regression
         # gates (obs/ledger.py) track alongside throughput.
         "mfu": summary["cost"]["mfu"],
@@ -415,6 +447,20 @@ def render(summary: Dict[str, Any]) -> str:
             f"  heal:       {he['count']} in-run recover(ies), "
             f"{he['downtime_s']:.0f}s down{shrink} | last: "
             f"{he['last_error']}")
+    da = summary.get("data", {})
+    if (da.get("quarantined") or da.get("retries")
+            or da.get("worker_deaths") or da.get("cap_trips")):
+        recs = ", ".join(str(q["record"]) for q in da["quarantined"][:8])
+        more = (f" (+{len(da['quarantined']) - 8} more)"
+                if len(da["quarantined"]) > 8 else "")
+        lines.append(
+            f"  data:       {len(da['quarantined'])} record(s) "
+            f"quarantined{': ' + recs + more if recs else ''} | "
+            f"{da['retries']} IO retr(ies), {da['retry_wait_s']:.0f}s "
+            f"backing off | {da['worker_deaths']} worker death(s), "
+            f"{da['worker_resurrections']} resurrected"
+            + (f" | CAP TRIPPED x{da['cap_trips']}"
+               if da.get("cap_trips") else ""))
     qu = summary.get("quorum", {})
     if qu.get("rounds"):
         last = qu.get("last") or {}

@@ -81,11 +81,18 @@ class StepTimer:
         i = start
         while True:
             t0 = time.perf_counter()
+            if self.watchdog is not None:
+                # Phase marks bracket the blocking next(): a stall event
+                # fired while we sit here is attributed to data-wait (the
+                # input plane), not dispatch (the device queue).
+                self.watchdog.note_phase("data_wait")
             try:
                 batch = next(it)
             except StopIteration:
                 return
             t1 = time.perf_counter()
+            if self.watchdog is not None:
+                self.watchdog.note_phase("dispatch")
             if self.track_shapes:
                 compile_track.note_batch(batch)
             self._t_dispatch = None

@@ -87,6 +87,7 @@ class StallWatchdog:
         self._fired = False
         self._paused = False
         self._stalls = 0
+        self._phase = ""
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -106,6 +107,16 @@ class StallWatchdog:
                 self._durations.append(float(duration_s))
             self._fired = False
             self._paused = False
+
+    def note_phase(self, name: str):
+        """Name the loop phase now running (``data_wait`` before the
+        loader's blocking ``next()``, ``dispatch`` once the batch is in
+        hand — StepTimer.iterate sets both). A later ``stall`` event
+        carries the last-noted phase, so the dump says WHERE the loop
+        was wedged — graftfeed's runbook (OUTAGES.md) splits "storage is
+        stuck" from "device queue is stuck" on this one field."""
+        with self._lock:
+            self._phase = name
 
     def threshold_s(self) -> float:
         with self._lock:
@@ -146,6 +157,7 @@ class StallWatchdog:
                 return False
             waited = now - self._last_beat
             fired = self._fired
+            phase = self._phase
             median = (statistics.median(self._durations)
                       if self._durations else None)
         threshold = self.threshold_s()
@@ -163,6 +175,7 @@ class StallWatchdog:
             "stall",
             waited_s=round(waited, 3),
             threshold_s=round(threshold, 3),
+            phase=phase or None,
             median_step_s=round(median, 4) if median is not None else None,
             stacks=_stack_dump(skip_ident=self._thread.ident))
         if self.recorder is not None:

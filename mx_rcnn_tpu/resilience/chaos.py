@@ -20,6 +20,13 @@ travels in the ``MX_RCNN_CHAOS`` environment variable so subprocess tests
     MX_RCNN_CHAOS="slow_step_at=1:2:250"           # host 1 drags a 250 ms
                                                    # tail from step 2 on
                                                    # (grafttower straggler)
+    MX_RCNN_CHAOS="data_corrupt_at=0:3"            # record 3 is rotten in
+                                                   # epoch 0 (graftfeed
+                                                   # quarantine)
+    MX_RCNN_CHAOS="data_io_error_at=0:3:2"         # record 3 flakes twice,
+                                                   # then reads fine
+    MX_RCNN_CHAOS="data_hang_at=0:3 hang_s=60"     # record 3's read hangs
+    MX_RCNN_CHAOS="data_worker_die_at=1"           # prefetch worker 1 dies
 
 Pairs are space- or comma-separated ``key=value``; unknown keys raise (a
 typo'd injection silently doing nothing would un-test the gate it was
@@ -64,6 +71,12 @@ SITES = frozenset({
                              # barrier_timeout_at injection makes THIS
                              # host skip arriving (a hang past the
                              # deadline), driving the exclusion path
+    "data_record_load",      # one roidb record load inside a prefetch
+                             # worker: data_corrupt_at / data_io_error_at
+                             # / data_hang_at fire here (data/feedguard.py)
+    "data_worker_loop",      # top of a prefetch worker's claim loop:
+                             # data_worker_die_at kills the thread here
+                             # (data/loader.py worker supervision)
 })
 
 #: Per-process injection state (e.g. how many backend probes have already
@@ -146,6 +159,28 @@ class ChaosSpec:
     #: quorum exclusion / min-fraction paths. The only barrier site
     #: today is "quorum_barrier".
     barrier_timeout_at: str = ""
+    #: Permanently corrupt one record: ``E:I`` makes every load of roidb
+    #: record index I during epoch E raise the bad-JPEG signature — the
+    #: graftfeed quarantine trigger ("data_record_load" site,
+    #: data/feedguard.py). Keyed by record identity, not stream
+    #: position, so a --resume auto replay of the epoch prefix observes
+    #: the same fault (or finds the record already quarantined).
+    data_corrupt_at: str = ""
+    #: Transient IO flake on one record: ``E:I:N`` fails the first N
+    #: load attempts of record I during epoch E with an EIO signature,
+    #: then lets the load through — the graftfeed retry path must ride
+    #: it out under data.record_deadline_s.
+    data_io_error_at: str = ""
+    #: Hang one record's load: ``E:I`` makes the load of record I during
+    #: epoch E sleep ``hang_s`` (cancel-aware) inside its prefetch
+    #: worker — the stuck-storage stand-in that must surface as
+    #: DataStallError within data.wait_deadline_s, not a silent hang.
+    data_hang_at: str = ""
+    #: Kill prefetch worker thread index K (once, "data_worker_loop"
+    #: site): the thread dies abruptly mid-claim — no error result, no
+    #: slot release — and graftfeed's supervision must resurrect it at
+    #: its queue position. -1 = disarmed (0 is a real worker index).
+    data_worker_die_at: int = -1
 
     @property
     def active(self) -> bool:
@@ -236,6 +271,73 @@ class ChaosSpec:
         host, at, ms = self.slow_step_at.split(":")
         if _host_index() == int(host) and step >= int(at):
             time.sleep(float(ms) / 1e3)
+
+    @staticmethod
+    def _at_match(armed: str, epoch: int, index: int):
+        """Split an armed ``E:I[:N]`` key; (None, None) unless E/I match."""
+        parts = armed.split(":")
+        if int(parts[0]) != epoch or int(parts[1]) != index:
+            return None, None
+        return parts, f"{epoch}:{index}"
+
+    def maybe_data_corrupt(self, epoch: int, index: int):
+        """Raise the permanently-corrupt-record signature when loading
+        roidb record ``index`` during ``epoch`` matches the armed
+        ``data_corrupt_at=E:I`` — fires on EVERY attempt (a rotten JPEG
+        does not heal on retry); quarantine is what stops the re-reads."""
+        if not self.data_corrupt_at:
+            return
+        parts, _ = self._at_match(self.data_corrupt_at, epoch, index)
+        if parts is not None:
+            raise ValueError(
+                f"corrupt JPEG data: premature end of data segment "
+                f"[injected corruption, record {index} epoch {epoch}, "
+                "chaos]")
+
+    def maybe_data_io_error(self, epoch: int, index: int):
+        """Fail the first N load attempts of record ``index`` during
+        ``epoch`` with a transient EIO signature, per the armed
+        ``data_io_error_at=E:I:N`` — then let the load through."""
+        if not self.data_io_error_at:
+            return
+        parts, key = self._at_match(self.data_io_error_at, epoch, index)
+        if parts is None:
+            return
+        n = int(parts[2])
+        done = _counters.get(f"data_io:{key}", 0)
+        if done < n:
+            _counters[f"data_io:{key}"] = done + 1
+            raise OSError(
+                5, "Input/output error (EIO) reading record "
+                   f"{index} [injected IO flake {done + 1}/{n}, chaos]")
+
+    def maybe_data_hang(self, epoch: int, index: int, cancel=None):
+        """Sleep ``hang_s`` (in cancel-aware 50 ms slices) when loading
+        record ``index`` during ``epoch`` matches the armed
+        ``data_hang_at=E:I`` — the stuck-storage read. ``cancel`` is a
+        nullary predicate (the prefetcher's stop flag) so a consumer
+        that already gave up (DataStallError) releases the worker."""
+        if not self.data_hang_at:
+            return
+        parts, _ = self._at_match(self.data_hang_at, epoch, index)
+        if parts is None:
+            return
+        deadline = time.monotonic() + self.hang_s
+        while time.monotonic() < deadline:
+            if cancel is not None and cancel():
+                return
+            time.sleep(0.05)
+
+    def maybe_worker_die(self, worker_index: int) -> bool:
+        """True exactly once when prefetch worker ``worker_index`` should
+        die abruptly (armed ``data_worker_die_at=K``) — the loader turns
+        this into a silent thread exit with its claim still pending."""
+        if (self.data_worker_die_at >= 0
+                and worker_index == self.data_worker_die_at
+                and not _counters.get("data_worker_die")):
+            _counters["data_worker_die"] = 1
+            return True
+        return False
 
     def maybe_barrier_timeout(self, site_name: str) -> bool:
         """True when this host should SKIP arriving at ``site_name`` —
@@ -338,6 +440,15 @@ def parse(text: str) -> ChaosSpec:
             raise ValueError(
                 f"bad {ENV_VAR} barrier_timeout_at site {site_name!r}; "
                 f"registered sites: {sorted(SITES)}")
+    for key, want in (("data_corrupt_at", 2), ("data_hang_at", 2),
+                      ("data_io_error_at", 3)):
+        if kw.get(key):
+            parts = kw[key].split(":")
+            if len(parts) != want or not all(p.isdigit() for p in parts):
+                shape = "E:I:N (epoch, record index, fail count)" \
+                    if want == 3 else "E:I (epoch, record index)"
+                raise ValueError(
+                    f"bad {ENV_VAR} {key} {kw[key]!r}; expected {shape}")
     return ChaosSpec(**kw)
 
 

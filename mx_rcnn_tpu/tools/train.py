@@ -18,6 +18,7 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.datasets import dataset_from_config
 from mx_rcnn_tpu.data.datasets.imdb import filter_roidb, merge_roidb
+from mx_rcnn_tpu.data.feedguard import FeedGuard
 from mx_rcnn_tpu.data.loader import AnchorLoader
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models.zoo import build_model, forward_train, init_params
@@ -255,6 +256,25 @@ def fit_detector(
 
         validate_canvas_pack(loader_cfg)
 
+    # graftfeed (data/feedguard.py; cfg.data): ONE guard per run, built
+    # before the first loader and shared across every heal-time /
+    # elastic rebuild below — the quarantine set and worker-death budget
+    # are run-scoped, not loader-scoped. Chaos comes up here too (not at
+    # the session loop) because the input plane has injection sites of
+    # its own now.
+    chaos_spec = chaos.from_env()
+    feed_guard = FeedGuard(
+        cfg.data, n_records=len(roidb), seed=seed,
+        elog=obs_log if obs_log.enabled else None,
+        quarantine_path=(os.path.join(os.path.dirname(obs_log.path),
+                                      "quarantine.jsonl")
+                         if obs_log.enabled else ""),
+        # --resume / --resume auto re-applies the interrupted run's
+        # quarantine file, so the resumed stream sees the SAME
+        # substitutions at the same positions (bit-exact parity).
+        resume=bool(resume),
+        chaos_spec=chaos_spec if chaos_spec.active else None)
+
     def _build_loader(n_shards: int):
         """Loader for ``n_shards`` data shards. Factored out because the
         session loop rebuilds it under ``resilience.elastic_mode=rescale``
@@ -267,7 +287,8 @@ def fit_detector(
             return AnchorLoader(roidb, loader_cfg, num_shards=n_shards,
                                 seed=seed,
                                 process_count=jax.process_count(),
-                                process_index=jax.process_index())
+                                process_index=jax.process_index(),
+                                guard=feed_guard)
         import inspect
 
         params_of = inspect.signature(loader_factory).parameters
@@ -499,7 +520,6 @@ def fit_detector(
     if cfg.resilience.preempt_handlers:
         guard = PreemptionGuard()
         guard.install()
-    chaos_spec = chaos.from_env()
 
     # graftheal (resilience/heal.py): a transient step-time backend loss
     # is recovered IN-PROCESS — capture the last known-good host state,

@@ -19,7 +19,8 @@ JAX_PLATFORMS=cpu python -m pytest -m chaos "$@"
 # events_p<k>.jsonl streams must exit 0 and print the straggler table the
 # OUTAGES "which host is the problem?" runbook starts from.
 FLEET_DIR="$(mktemp -d)"
-trap 'rm -rf "$FLEET_DIR"' EXIT
+FEED_DIR="$(mktemp -d)"
+trap 'rm -rf "$FLEET_DIR" "$FEED_DIR"' EXIT
 for i in 0 1; do
   JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
     python tests/_resilience_driver.py --fit "$FLEET_DIR/run" \
@@ -33,3 +34,29 @@ JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.obs.report --fleet "$FLEET_DIR/obs" \
   | tee "$FLEET_DIR/report.txt"
 grep -q "straggler table" "$FLEET_DIR/report.txt"
 echo "fleet-report smoke: OK"
+
+# graftfeed data-chaos smoke: (1) a corrupt record on a tiny CPU fit must
+# quarantine + complete, and the report must fold the `data` events into
+# the line the OUTAGES "data plane broke" runbook starts from; (2) a
+# hung batch must crash with DataStallError inside the data-wait
+# deadline, not wedge the smoke.
+JAX_PLATFORMS=cpu MX_RCNN_CHAOS="data_corrupt_at=0:1" \
+  python tests/_resilience_driver.py --fit "$FEED_DIR/run" \
+    --obs-dir "$FEED_DIR/obs_corrupt" \
+    --set data.quarantine_max_fraction=0.5
+test -s "$FEED_DIR/obs_corrupt/quarantine.jsonl"
+JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.obs.report "$FEED_DIR/obs_corrupt" \
+  | tee "$FEED_DIR/report_corrupt.txt"
+grep -q "record(s) quarantined" "$FEED_DIR/report_corrupt.txt"
+if JAX_PLATFORMS=cpu MX_RCNN_CHAOS="data_hang_at=0:2 hang_s=600" \
+  timeout -k 10 300 \
+  python tests/_resilience_driver.py --fit "$FEED_DIR/hang" \
+    --end-epoch 1 --obs-dir "$FEED_DIR/obs_hang" \
+    --set data.wait_deadline_s=4.0 --set obs.stall_min_s=0.3 \
+    --set obs.stall_factor=0.01 --set obs.watchdog_poll_s=0.1; then
+  echo "data-hang smoke: expected DataStallError crash, run completed" >&2
+  exit 1
+fi
+grep -q "DataStallError" "$FEED_DIR/obs_hang"/events*.jsonl
+test -e "$FEED_DIR/obs_hang/flight_crash.json"
+echo "data-chaos smoke: OK"

@@ -1643,6 +1643,106 @@ def test_thread_race_near_miss_init_writes_and_threadless_class():
     assert "thread-shared-mutation" not in rules_of(findings)
 
 # ---------------------------------------------------------------------------
+# blocking-queue-no-timeout (graftfeed): uncancellable queue waits
+# ---------------------------------------------------------------------------
+
+def test_queue_timeout_flags_blocking_get_and_put_in_thread_class():
+    """The wedged-worker shape: a prefetcher hands batches over a
+    Queue and both ends block forever — close() can never join."""
+    findings = lint("""
+        import queue
+        import threading
+
+        class Prefetcher:
+            def __init__(self):
+                self._q = queue.Queue(4)
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                self._q.put(self._load())      # producer wedges on full
+
+            def __iter__(self):
+                yield self._q.get()            # consumer wedges on empty
+    """)
+    assert sum(f.rule == "blocking-queue-no-timeout"
+               for f in findings) == 2
+    msg = next(f for f in findings
+               if f.rule == "blocking-queue-no-timeout").message
+    assert "timeout" in msg
+
+
+def test_queue_timeout_flags_module_level_thread_target():
+    """A plain worker function spun up via Thread(target=fn), blocking
+    on a local queue."""
+    findings = lint("""
+        import threading
+        from queue import Queue
+
+        def pump(load):
+            q = Queue()
+            while True:
+                q.put(load())
+
+        threading.Thread(target=pump, daemon=True).start()
+    """)
+    assert sum(f.rule == "blocking-queue-no-timeout"
+               for f in findings) == 1
+
+
+def test_queue_timeout_near_miss_timeout_and_nonblocking_forms():
+    """Every escape hatch is clean: timeout= on either op, block=False
+    (keyword or positional), and the *_nowait spellings."""
+    findings = lint("""
+        import queue
+        import threading
+
+        class Prefetcher:
+            def __init__(self):
+                self._q = queue.Queue(4)
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                while True:
+                    try:
+                        self._q.put(1, timeout=0.1)
+                        self._q.put_nowait(2)
+                    except queue.Full:
+                        continue
+
+            def drain(self):
+                try:
+                    self._q.get(False)
+                    self._q.get(block=False)
+                    return self._q.get(timeout=0.1)
+                except queue.Empty:
+                    return None
+    """)
+    assert "blocking-queue-no-timeout" not in rules_of(findings)
+
+
+def test_queue_timeout_near_miss_threadless_class_out_of_scope():
+    """No thread constructed => a blocked call deadlocks loudly on the
+    first call; single-threaded queue use is out of scope. dict.get/put
+    lookalikes never count as queue receivers."""
+    findings = lint("""
+        import queue
+
+        class Buffer:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._meta = {}
+
+            def push(self, x):
+                self._q.put(x)
+
+            def pop(self):
+                self._meta.get("hits")
+                return self._q.get()
+    """)
+    assert "blocking-queue-no-timeout" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
 # wall-time-duration (grafttower): durations from wall-clock subtraction
 # ---------------------------------------------------------------------------
 
